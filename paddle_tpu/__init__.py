@@ -1,0 +1,102 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of PaddlePaddle's capabilities (reference:
+/root/reference, efreading/Paddle ~v2.0) for TPU: JAX/XLA is the compiled
+execution engine (replacing the reference's C++ Executor + CUDA kernel
+registry), Pallas provides custom TPU kernels, and jax.sharding meshes
+replace NCCL ring-id collectives. The public API mirrors paddle 2.x so a
+reference user can switch with minimal changes.
+
+Layer map vs the reference (SURVEY.md §1):
+- layers 0-3 (platform/memory/framework/operators) -> core/ + tensor/ over
+  XLA; HBM is runtime-managed, kernels are jnp/lax/Pallas lowerings.
+- layer 4 (imperative) -> core/autograd eager tape.
+- layers 5/9 (distributed) -> distributed/ (mesh + collectives + fleet).
+- layers 7-8 (python api) -> this package's nn/optimizer/amp/io/jit/...
+- layer 10 (hapi) -> hapi/Model. layer 11 (inference) -> jit.save + export.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import warnings as _warnings
+
+# int64/float64 silently canonicalize to 32-bit unless JAX x64 is enabled;
+# that is the intended TPU behavior (int32/bf16-native), so hide the noise.
+_warnings.filterwarnings(
+    "ignore", message=".*requested in astype is not available.*")
+_warnings.filterwarnings(
+    "ignore", message=".*Explicitly requested dtype.*is not available.*")
+
+from .core.tensor import Parameter, Tensor, to_tensor, is_tensor  # noqa: F401
+from .core.autograd import (no_grad, enable_grad, set_grad_enabled,  # noqa: F401
+                            is_grad_enabled, grad)
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    set_default_dtype, get_default_dtype,
+    bool_, uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+)
+from .core.flags import set_flags, get_flags  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import tensor_methods as _tensor_methods  # noqa: F401  (patch Tensor)
+
+from . import tensor  # noqa: F401
+from . import device  # noqa: F401
+from .device import CPUPlace, CUDAPlace, TPUPlace, get_device, set_device  # noqa: F401
+
+# Subpackages imported lazily to keep import light and avoid cycles.
+import importlib as _importlib
+
+_LAZY_MODULES = (
+    "nn", "optimizer", "io", "metric", "amp", "jit", "static",
+    "distributed", "vision", "text", "hapi", "callbacks", "profiler",
+    "framework", "regularizer", "linalg", "distribution", "incubate",
+    "utils", "models", "autograd", "sparse", "fft", "signal", "onnx_export",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_MODULES:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "save":
+        from .framework.io import save as _save
+        return _save
+    if name == "load":
+        from .framework.io import load as _load
+        return _load
+    if name == "summary":
+        from .hapi.model_summary import summary as _summary
+        return _summary
+    if name == "Model":
+        from .hapi.model import Model as _Model
+        return _Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel as _DP
+        return _DP
+    if name == "flops":
+        from .hapi.model_summary import flops as _flops
+        return _flops
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def in_dynamic_mode():
+    """True when executing eagerly (reference paddle.in_dynamic_mode)."""
+    try:
+        from .jit.api import in_tracing
+        return not in_tracing()
+    except ImportError:
+        return True
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no separate static mode; use paddle_tpu.jit.to_static "
+        "to compile (XLA traces and compiles the whole step).")

@@ -1,0 +1,8 @@
+from . import autograd, dtype, errors, flags, random  # noqa: F401
+from .autograd import (apply, backward, enable_grad, grad, is_grad_enabled,  # noqa: F401
+                       no_grad, set_grad_enabled)
+from .dtype import (convert_dtype, get_default_dtype, set_default_dtype)  # noqa: F401
+from .errors import enforce, EnforceNotMet  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401

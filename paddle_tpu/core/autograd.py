@@ -1,0 +1,282 @@
+"""Eager autograd: tape + reverse engine.
+
+TPU-native re-design of the reference's imperative runtime:
+- Tracer::TraceOp (/root/reference/paddle/fluid/imperative/tracer.cc:132)
+  recorded a grad-op node per executed op; here `apply()` records a GradNode
+  whose backward is the op's jax.vjp closure (XLA computes the actual VJP,
+  no per-op hand-written grad kernels needed).
+- BasicEngine (/root/reference/paddle/fluid/imperative/basic_engine.cc:39,265)
+  walked grad nodes from the loss; here `backward()` drains nodes in reverse
+  creation order (a heap over monotone node ids — same effect as the
+  reference's dependency counting) and accumulates leaf grads like
+  gradient_accumulator.cc.
+
+The compiled path (paddle_tpu.jit.to_static / trainers) bypasses this tape
+entirely and uses jax.grad over pure functions — the tape exists for
+dygraph-style usability; jit is the performance path.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .errors import EnforceNotMet, InvalidArgumentError, PreconditionNotMetError
+from .flags import GLOBAL_FLAGS
+
+_node_counter = itertools.count()
+_tls = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+class set_grad_enabled:
+    """paddle.set_grad_enabled parity; usable as context manager."""
+
+    def __init__(self, mode: bool):
+        self.prev = _grad_enabled()
+        _tls.grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self.prev
+        return False
+
+
+class no_grad:
+    """paddle.no_grad parity: context manager AND decorator."""
+
+    def __enter__(self):
+        self.prev = _grad_enabled()
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self.prev = _grad_enabled()
+        _tls.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self.prev
+        return False
+
+
+class GradNode:
+    """One recorded op application. vjp_fn maps output cotangents ->
+    input cotangents (aligned with `inputs`)."""
+
+    __slots__ = ("id", "vjp_fn", "inputs", "out_avals", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        self.id = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor]
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name or 'op'} id={self.id}>"
+
+
+def _check_nan_inf(arrs, name):
+    # FLAGS_check_nan_inf parity (reference nan_inf_utils_detail.cc:293).
+    for a in arrs:
+        if hasattr(a, "dtype") and np.issubdtype(np.asarray(a).dtype, np.floating):
+            if not bool(jax.numpy.isfinite(a).all()):
+                raise EnforceNotMet(
+                    f"Operator {name or 'op'} output contains NaN or Inf.")
+
+
+def apply(fn, *args, name: str = ""):
+    """Run `fn` over the unwrapped arrays of `args`, recording a GradNode if
+    any input Tensor wants gradients. Non-Tensor args pass through
+    undifferentiated. Returns Tensor or tuple of Tensors mirroring fn's
+    output structure.
+    """
+    from .tensor import Tensor
+
+    arrs = tuple(a.data if isinstance(a, Tensor) else a for a in args)
+    needs_grad = _grad_enabled() and any(
+        isinstance(a, Tensor) and not a.stop_gradient for a in args
+    )
+
+    if needs_grad:
+        out, vjp_fn = jax.vjp(fn, *arrs)
+    else:
+        out = fn(*arrs)
+        vjp_fn = None
+
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+
+    if GLOBAL_FLAGS.get("check_nan_inf"):
+        _check_nan_inf(outs, name)
+
+    if vjp_fn is None:
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+    else:
+        tensor_inputs = [a if isinstance(a, Tensor) else None for a in args]
+        node = GradNode(
+            vjp_fn,
+            tensor_inputs,
+            [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs],
+            name=name or getattr(fn, "__name__", ""),
+        )
+        wrapped = tuple(
+            Tensor(o, stop_gradient=False, _creator=(node, i))
+            for i, o in enumerate(outs)
+        )
+    return wrapped if multi else wrapped[0]
+
+
+def _accumulate(dst, val):
+    return val if dst is None else dst + val
+
+
+def _is_float0(x):
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
+                capture: Optional[dict] = None):
+    """Core reverse pass. `capture`: id(tensor) -> slot dict to collect grads
+    for paddle.grad()-style calls instead of (or in addition to) writing
+    .grad on leaves."""
+    from .tensor import Tensor
+
+    # node -> {out_idx: cotangent}
+    pending: dict = {}
+    heap: List[Tuple[int, GradNode]] = []
+    seen = set()
+
+    def push(node, idx, cot):
+        slots = pending.setdefault(node, {})
+        slots[idx] = _accumulate(slots.get(idx), cot)
+        if node.id not in seen:
+            seen.add(node.id)
+            heapq.heappush(heap, (-node.id, node))
+
+    retain_all = GLOBAL_FLAGS.get("retain_grad_for_all_tensor")
+
+    for root, g in zip(roots, root_grads):
+        if root.stop_gradient:
+            raise PreconditionNotMetError(
+                "backward() on a tensor with stop_gradient=True")
+        if root._creator is not None:
+            node, idx = root._creator
+            push(node, idx, g)
+        else:
+            root._accumulate_grad(g)
+
+    while heap:
+        _, node = heapq.heappop(heap)
+        slots = pending.pop(node)
+        cots = []
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            c = slots.get(i)
+            if c is None:
+                c = jax.numpy.zeros(shape, dtype)
+            cots.append(c)
+        if node.vjp_fn is None:
+            raise PreconditionNotMetError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True if you need to.")
+        out = cots[0] if len(cots) == 1 else tuple(cots)
+        in_grads = node.vjp_fn(out)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or t.stop_gradient or _is_float0(g):
+                continue
+            if capture is not None and id(t) in capture:
+                capture[id(t)]["grad"] = _accumulate(capture[id(t)].get("grad"), g)
+                if t._creator is None and not accumulate_leaf:
+                    continue
+            if t._creator is not None:
+                cnode, cidx = t._creator
+                push(cnode, cidx, g)
+                if retain_all or t._retain_grads:
+                    t._accumulate_grad(g)
+            elif accumulate_leaf:
+                t._accumulate_grad(g)
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward() implementation (reference
+    varbase_patch_methods.py:136 -> BasicEngine::Execute)."""
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if grad_tensor is None:
+        if tensor.size != 1:
+            g = jnp.ones(tensor.data.shape, tensor.data.dtype)
+        else:
+            g = jnp.ones_like(tensor.data)
+    else:
+        g = grad_tensor.data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    _run_engine([tensor], [g], retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity (reference partial_grad_engine.cc). Eager tape
+    supports first-order; for higher-order use the functional API
+    (paddle_tpu.incubate.functional.grad = jax.grad composition).
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if create_graph:
+        raise InvalidArgumentError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle_tpu.jit / jax.grad composition for higher-order grads.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [jnp.ones_like(o.data) for o in outputs]
+    else:
+        grad_outputs = [
+            jnp.ones_like(o.data) if g is None else (g.data if isinstance(g, Tensor) else jnp.asarray(g))
+            for o, g in zip(outputs, grad_outputs)
+        ]
+    capture = {id(t): {} for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else False
+    _run_engine(outputs, grad_outputs, retain_graph=retain,
+                accumulate_leaf=False, capture=capture)
+    results = []
+    for t in inputs:
+        g = capture[id(t)].get("grad")
+        if g is None and not allow_unused:
+            raise InvalidArgumentError(
+                "One of the differentiated tensors appears to not have been "
+                "used in the graph; pass allow_unused=True to return None.")
+        results.append(None if g is None else Tensor(g, stop_gradient=True))
+    return results

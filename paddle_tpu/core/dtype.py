@@ -1,0 +1,87 @@
+"""Dtype registry and defaults.
+
+TPU-native re-design of the reference's numeric type layer
+(/root/reference/paddle/fluid/platform/{float16,bfloat16,complex64}.h and
+framework.proto VarType.Type): instead of hand-written host types with
+intrinsics, dtypes are jnp dtypes with a paddle-style string alias table.
+bfloat16 is first-class (TPU MXU native), fp16 is kept for parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Paddle-style names -> jnp dtypes
+_DTYPE_ALIASES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+# canonical exports (usable as paddle_tpu.float32 etc.)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string alias, np/jnp dtype, None) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_ALIASES:
+            raise ValueError(f"Unknown dtype {dtype!r}; known: {sorted(_DTYPE_ALIASES)}")
+        return np.dtype(_DTYPE_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Paddle-style short name for a dtype."""
+    d = np.dtype(dtype)
+    return d.name
+
+
+def set_default_dtype(dtype):
+    """Set the global default float dtype (paddle.set_default_dtype parity,
+    reference: python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if d.kind != "f":
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return np.dtype(_default_dtype).name
+
+
+def default_float_dtype():
+    return _default_dtype
+
+
+def is_floating(dtype) -> bool:
+    return np.dtype(dtype).kind == "f"
+
+
+def is_integer(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u")

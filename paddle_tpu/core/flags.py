@@ -1,0 +1,116 @@
+"""Global runtime flag registry.
+
+TPU-native equivalent of the reference's gflags registry
+(/root/reference/paddle/fluid/platform/flags.cc:33-565) and its Python
+surface paddle.set_flags/get_flags
+(/root/reference/python/paddle/fluid/framework.py:5822,5845).
+
+Flags are typed, documented, env-overridable (FLAGS_<name>), and looked up
+at runtime by subsystems (nan/inf checking, deterministic ops, allocator
+staging sizes, logging verbosity). The CUDA-specific flags of the reference
+(gpu memory fraction, cudnn knobs) become TPU/XLA-relevant knobs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    validator: Optional[Callable[[Any], bool]] = None
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name, default, help="", type=None, validator=None):
+        with self._lock:
+            t = type if type is not None else default.__class__
+            self._flags[name] = _Flag(name, default, t, help, validator)
+            env = os.environ.get("FLAGS_" + name)
+            self._values[name] = self._parse(t, env) if env is not None else default
+
+    @staticmethod
+    def _parse(t, s):
+        if t is bool:
+            return s.strip().lower() in ("1", "true", "yes", "on")
+        return t(s)
+
+    def set(self, name, value):
+        with self._lock:
+            if name not in self._flags:
+                from .errors import NotFoundError
+                raise NotFoundError(f"Unknown flag {name!r}")
+            f = self._flags[name]
+            if f.validator is not None and not f.validator(value):
+                from .errors import InvalidArgumentError
+                raise InvalidArgumentError(f"Invalid value {value!r} for flag {name}")
+            self._values[name] = f.type(value) if not isinstance(value, f.type) else value
+
+    def get(self, name):
+        with self._lock:
+            if name not in self._values:
+                from .errors import NotFoundError
+                raise NotFoundError(f"Unknown flag {name!r}")
+            return self._values[name]
+
+    def has(self, name):
+        return name in self._flags
+
+    def all(self):
+        with self._lock:
+            return dict(self._values)
+
+
+GLOBAL_FLAGS = FlagRegistry()
+_D = GLOBAL_FLAGS.define
+
+# Mirrors of the reference's behavioral flags (platform/flags.cc), TPU-relevant subset.
+_D("check_nan_inf", False, "Scan op outputs for NaN/Inf after each eager op "
+   "(reference flags.cc:44 -> nan_inf_utils_detail.cc).")
+_D("benchmark", False, "Synchronize after each eager op for timing (flags.cc).")
+_D("paddle_num_threads", 1, "Host compute threads for dataloader workers.")
+_D("eager_delete_tensor_gb", 0.0, "Kept for parity; XLA manages HBM lifetime.")
+_D("use_system_allocator", False, "Kept for parity.")
+_D("allocator_strategy", "auto_growth", "Host staging allocator strategy "
+   "(naive_best_fit|auto_growth), reference allocator_strategy.cc.")
+_D("fraction_of_gpu_memory_to_use", 0.92, "Parity alias; on TPU maps to "
+   "XLA preallocation fraction.")
+_D("init_allocated_mem", False, "Fill freshly allocated host staging buffers.")
+_D("cpu_deterministic", False, "Force deterministic reductions.")
+_D("max_inplace_grad_add", 0, "Eager grad accumulation chunking (parity).")
+_D("call_stack_level", 1, "Error message verbosity (1=user frames, 2=full).")
+_D("sort_sum_gradient", False, "Deterministic gradient accumulation order "
+   "(reference gradient_accumulator.cc).")
+_D("retain_grad_for_all_tensor", False, "Keep .grad on non-leaf tensors.")
+_D("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+_D("log_level", 0, "VLOG-style verbosity.")
+_D("prim_all", False, "Reserved: decompose ops to primitives.")
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity (fluid/framework.py:5822)."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        GLOBAL_FLAGS.set(name, v)
+
+
+def get_flags(flags):
+    """paddle.get_flags parity (fluid/framework.py:5845)."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        out[k] = GLOBAL_FLAGS.get(name)
+    return out

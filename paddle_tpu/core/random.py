@@ -1,0 +1,98 @@
+"""Random state management.
+
+The reference seeds per-device cuRAND generators (Program.random_seed,
+paddle.seed — /root/reference/python/paddle/fluid/framework.py and
+framework/generator.cc). JAX randomness is functional (explicit PRNG keys),
+so this module bridges the two worlds:
+
+- A global stateful `Generator` gives paddle-style implicit randomness for
+  eager mode (`paddle_tpu.seed(n)`; each random op draws a fresh subkey).
+- `rng_guard(key)` pushes an explicit key stack used while tracing pure
+  functions (jit/to_static/train steps), so compiled code gets traced key
+  arguments instead of baked-in constants.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(int(seed))
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_default_generator = Generator(0)
+_tls = threading.local()
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(n: int) -> Generator:
+    """paddle.seed parity."""
+    return _default_generator.manual_seed(n)
+
+
+def get_rng_state():
+    return _default_generator._key
+
+
+def set_rng_state(key):
+    _default_generator._key = key
+
+
+class rng_guard:
+    """Push an explicit PRNG key for the duration of a trace.
+
+    While active, `next_key()` derives keys by folding a counter into the
+    pushed key — fully traceable, so dropout etc. stay random across steps
+    when the key is a function argument.
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+        return False
+
+
+def has_explicit_key() -> bool:
+    return bool(getattr(_tls, "stack", None))
+
+
+def next_key():
+    """Draw a PRNG key: from the innermost rng_guard if active, else the
+    global generator."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        entry = stack[-1]
+        key = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return key
+    return _default_generator.next_key()

@@ -1,0 +1,309 @@
+"""Tensor: the user-facing array type.
+
+TPU-native re-design of the reference Tensor/LoDTensor/VarBase stack
+(/root/reference/paddle/fluid/framework/tensor.h:89,
+imperative/layer.h VarBase): instead of a strided device buffer plus a
+separate grad Variable, a Tensor is a thin handle over an immutable
+jax.Array (XLA-managed HBM — no user-space allocator needed, reference
+memory/allocation/allocator_facade.h is subsumed by the runtime) carrying
+autograd metadata (stop_gradient, creator GradNode, accumulated .grad).
+
+Tensors are registered as a jax pytree node so they flow through jit /
+grad / shard_map; the autograd tape (core.autograd) is the eager path and
+is bypassed under tracing.
+
+LoD (level-of-detail variable-length sequences, lod_tensor.h:114) is NOT
+carried on the tensor: TPU/XLA wants static shapes, so variable-length
+data uses dense padding + masks (see paddle_tpu.text utilities), which is
+the idiomatic equivalent.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .dtype import convert_dtype, default_float_dtype
+from .errors import InvalidArgumentError, PreconditionNotMetError
+
+_tensor_counter = 0
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_creator", "name",
+                 "persistable", "trainable", "_retain_grads", "__weakref__",
+                 "__dict__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None,
+                 _creator=None, persistable: bool = False):
+        global _tensor_counter
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = bool(stop_gradient)
+        self.grad = None
+        self._creator = _creator
+        if name is None:
+            name = f"generated_tensor_{_tensor_counter}"
+            _tensor_counter += 1
+        self.name = name
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+        self._retain_grads = False
+
+    # ---- raw array access -------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value.data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    # ---- shape & dtype ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def T(self):
+        from .autograd import apply
+        return apply(lambda a: a.T, self, name="transpose")
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        if callable(devs):
+            ds = list(devs())
+            return ds[0] if len(ds) == 1 else ds
+        return None
+
+    @property
+    def is_leaf(self):
+        return self._creator is None
+
+    # ---- host transfer ----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args) if args else self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def cpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ---- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def _accumulate_grad(self, g):
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True, name=self.name + "@GRAD")
+        else:
+            self.grad = Tensor(self.grad._data + g, stop_gradient=True,
+                               name=self.name + "@GRAD")
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._creator = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return autograd.apply(lambda a: a + 0, self, name="clone")
+
+    # ---- in-place-style setters (functional under the hood) ---------------
+    def set_value(self, value):
+        value = value.data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise InvalidArgumentError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # ---- casting ----------------------------------------------------------
+    def astype(self, dtype):
+        d = convert_dtype(dtype)
+        return autograd.apply(lambda a: a.astype(d), self, name="cast")
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype and/or device; device moves are explicit on TPU
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = convert_dtype(a)
+            except (ValueError, TypeError):
+                d = None
+            if d is not None:
+                out = out.astype(d)
+        return out
+
+    # ---- indexing ---------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = tuple(i.data if isinstance(i, Tensor) else i for i in idx) \
+            if isinstance(idx, tuple) else (idx.data if isinstance(idx, Tensor) else idx)
+        return autograd.apply(lambda a: a[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = tuple(i.data if isinstance(i, Tensor) else i for i in idx) \
+            if isinstance(idx, tuple) else (idx.data if isinstance(idx, Tensor) else idx)
+        v = value.data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ---- python protocol --------------------------------------------------
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)!r})")
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+
+# NOTE: aux data must be semantic-only (no per-tensor generated names) so
+# same-shaped Tensors share a treedef — otherwise every jit call retraces.
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    (sg,) = aux
+    (data,) = children
+    t = Tensor.__new__(Tensor)
+    t._data = data
+    t.stop_gradient = sg
+    t.grad = None
+    t._creator = None
+    t.name = "tensor"
+    t.persistable = False
+    t.trainable = not sg
+    t._retain_grads = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: framework.py Parameter; VarBase with
+    persistable=True, stop_gradient=False)."""
+
+    def __init__(self, data, name=None, trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name,
+                         persistable=True)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._data,), (p.stop_gradient,)),
+    lambda aux, children: _tensor_unflatten(aux, children),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    else:
+        arr = data
+    d = convert_dtype(dtype)
+    if not isinstance(arr, jax.Array):
+        np_arr = np.asarray(arr)
+        if d is None and np_arr.dtype == np.float64:
+            d = default_float_dtype()
+        arr = jnp.asarray(np_arr, dtype=d)
+    elif d is not None:
+        arr = arr.astype(d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,13 @@
+"""paddle_tpu.tensor — the tensor op library (reference:
+python/paddle/tensor/__init__.py)."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from ..core.tensor import Tensor, to_tensor, is_tensor  # noqa: F401

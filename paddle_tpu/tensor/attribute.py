@@ -1,0 +1,47 @@
+"""Tensor attribute ops (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def shape(input):
+    """paddle.shape returns a 1-D int tensor (shape_op.cc)."""
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+    return Tensor(jnp.asarray(x.data.shape, dtype=jnp.int32))
+
+
+def rank(input):
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return np.issubdtype(np.dtype(x.dtype), np.floating)
+
+
+def is_integer(x):
+    return np.issubdtype(np.dtype(x.dtype), np.integer)
+
+
+def is_complex(x):
+    return np.issubdtype(np.dtype(x.dtype), np.complexfloating)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x, name="real")
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x, name="imag")
+
+
+def conj(x, name=None):
+    return apply(jnp.conj, x, name="conj")
+
+
+def angle(x, name=None):
+    return apply(jnp.angle, x, name="angle")

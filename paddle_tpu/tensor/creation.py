@@ -1,0 +1,184 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (fill_constant,
+assign, arange, eye, ... backed by C++ ops in
+/root/reference/paddle/fluid/operators/fill_constant_op.cc etc.).
+Here every creation op lowers to a jnp constructor.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else default_float_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.zeros_like(x.data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.ones_like(x.data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.full_like(x.data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        py = (start, end, step)
+        d = np.dtype(np.int64) if all(
+            isinstance(v, (int, np.integer)) for v in py) else default_float_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return apply(_diag, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+
+    def _emb(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply(_emb, x, name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def assign(x, output=None):
+    """paddle.assign parity (operators/assign_op.cc)."""
+    src = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._data = src
+        return output
+    return Tensor(src)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, name="complex")
+
+
+import jax  # noqa: E402  (used by complex)

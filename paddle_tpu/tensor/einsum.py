@@ -1,0 +1,13 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py). Maps directly to
+jnp.einsum — XLA fuses it into MXU dot_generals."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def einsum(equation, *operands, name=None):
+    ops = [o if isinstance(o, Tensor) else to_tensor(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *ops, name="einsum")

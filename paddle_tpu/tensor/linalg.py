@@ -1,0 +1,223 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py (norm_op.cc, p_norm_op.cc,
+cholesky_op.cc, svd, qr, matrix_power, ...). Decompositions lower to
+XLA's native linalg (QR/SVD/Cholesky run on TPU via XLA custom calls).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+from .math import matmul, bmm, dot, mv  # noqa: F401  (re-export)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    """paddle.linalg.norm: frobenius by default; p in {1,2,inf,-inf,'fro','nuc'} or float."""
+    def _norm(a):
+        pp = p
+        if pp is None:
+            pp = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+        if pp == "fro":
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if pp in (np.inf, float("inf")):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pp in (-np.inf, float("-inf")):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sum(jnp.abs(a) ** pp, axis=ax, keepdims=keepdim) ** (1.0 / pp)
+
+    return apply(_norm, x, name="norm")
+
+
+def p_norm(x, p=2, axis=None, keepdim=False):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=list(axis), keepdim=keepdim)
+
+
+def cond(x, p=None, name=None):
+    x = _t(x)
+    return Tensor(jnp.asarray(np.linalg.cond(np.asarray(x.data),
+                                             p if p is not None else 2)))
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(_chol, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cs(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2), z,
+                                                 lower=False)
+    return apply(_cs, x, y, name="cholesky_solve")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inverse")
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="determinant")
+
+
+def slogdet(x, name=None):
+    def _sld(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(_sld, x, name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 x, name="svd")
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, name="svdvals")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = _t(x)
+    import scipy.linalg as sla
+    a = np.asarray(x.data)
+    lu_, piv = sla.lu_factor(a)
+    outs = (Tensor(jnp.asarray(lu_)), Tensor(jnp.asarray(piv.astype(np.int32) + 1)))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def eig(x, name=None):
+    x = _t(x)
+    w, v = np.linalg.eig(np.asarray(x.data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
+                 x, name="eigh")
+
+
+def eigvals(x, name=None):
+    x = _t(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x.data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a), x, name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = _t(x)
+    return Tensor(jnp.linalg.matrix_rank(x.data, rtol=tol))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                 x, name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(lambda a, b: jnp.linalg.solve(a, b), x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _ts(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(_ts, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = _t(x), _t(y)
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x.data), np.asarray(y.data),
+                                         rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+            Tensor(jnp.asarray(rank)), Tensor(jnp.asarray(sv)))
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(_cross, x, y, name="cross")
+
+
+def multi_dot(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(list(arrs)), *xs,
+                 name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights.data if isinstance(fweights, Tensor) else fweights
+    aw = aweights.data if isinstance(aweights, Tensor) else aweights
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def _hp2d(a, t):
+        m, n = a.shape
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[0]):
+            ar = jnp.arange(m)
+            v = jnp.where(ar > i, a[:, i], jnp.where(ar == i, 1.0, 0.0))
+            H = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            q = q @ H
+        return q[:, :n]
+
+    def _hp(a, t):
+        if a.ndim == 2:
+            return _hp2d(a, t)
+        batch = a.shape[:-2]
+        af = a.reshape((-1,) + a.shape[-2:])
+        tf = t.reshape((-1, t.shape[-1]))
+        out = jax.vmap(_hp2d)(af, tf)
+        return out.reshape(batch + out.shape[-2:])
+
+    return apply(_hp, x, tau, name="householder_product")

@@ -1,0 +1,72 @@
+"""Comparison / logical / bitwise ops.
+
+Reference parity: python/paddle/tensor/logic.py (compare_op.cc,
+logical_op.cc, bitwise ops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _cmp(fname, jfn):
+    def op(x, y, name=None):
+        return apply(jfn, x, y, name=fname)
+    op.__name__ = fname
+    return op
+
+
+equal = _cmp("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _cmp("not_equal", lambda a, b: jnp.not_equal(a, b))
+greater_than = _cmp("greater_than", lambda a, b: jnp.greater(a, b))
+greater_equal = _cmp("greater_equal", lambda a, b: jnp.greater_equal(a, b))
+less_than = _cmp("less_than", lambda a, b: jnp.less(a, b))
+less_equal = _cmp("less_equal", lambda a, b: jnp.less_equal(a, b))
+logical_and = _cmp("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _cmp("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+bitwise_and = _cmp("bitwise_and", lambda a, b: jnp.bitwise_and(a, b))
+bitwise_or = _cmp("bitwise_or", lambda a, b: jnp.bitwise_or(a, b))
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: jnp.bitwise_xor(a, b))
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    x, y = _t(x), _t(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.array_equal(x.data, y.data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _t(x), _t(y)
+    return Tensor(jnp.allclose(x.data, y.data, rtol=float(rtol),
+                               atol=float(atol), equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=float(rtol),
+                                          atol=float(atol), equal_nan=equal_nan),
+                 x, y, name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

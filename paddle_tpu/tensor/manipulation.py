@@ -1,0 +1,479 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py and C++ kernels
+(reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc, gather_op.cc,
+scatter_op.cc, ...). All static-shape — XLA requires it, and that is also
+what makes these free (reshape/transpose usually fuse away entirely).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq.data))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(v.item()) if isinstance(v, Tensor) else int(v) for v in seq)
+
+
+def reshape(x, shape, name=None):
+    s = _ints(shape)
+    return apply(lambda a: jnp.reshape(a, s), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x.data, _ints(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flat(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(_flat, x, name="flatten")
+
+
+def transpose(x, perm, name=None):
+    p = _ints(perm)
+    return apply(lambda a: jnp.transpose(a, p), x, name="transpose")
+
+
+def t(x, name=None):
+    return apply(lambda a: a.T, x, name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    xs = [_t(v) for v in x]
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=ax), *xs, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    xs = [_t(v) for v in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *xs, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num if num is not None else x.shape[axis]
+    outs = apply(
+        lambda a: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(a, n, axis=axis)),
+        x, name="unstack")
+    return list(outs)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise InvalidArgumentError(
+                f"split: dim {dim} not divisible by {num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = list(_ints(num_or_sections))
+        total = 0
+        unk = -1
+        for i, s in enumerate(sizes):
+            if s < 0:
+                unk = i
+            else:
+                total += s
+        if unk >= 0:
+            sizes[unk] = dim - total
+    offsets = np.cumsum([0] + sizes[:-1])
+    outs = apply(
+        lambda a: tuple(jax.lax.slice_in_dim(a, int(o), int(o) + int(s), axis=ax)
+                        for o, s in zip(offsets, sizes)),
+        x, name="split")
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def _sq(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes)
+        keep = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=keep) if keep else a
+    return apply(_sq, x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    def _unsq(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(_unsq, x, name="unsqueeze")
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return apply(lambda a: jnp.flip(a, axis=axes), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+    return apply(lambda a: jnp.roll(a, sh, axis=ax), x, name="roll")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _ints(shape)
+
+    def _expand(a):
+        target = list(s)
+        # -1 means keep original dim (paddle semantics)
+        offset = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(target))
+
+    return apply(_expand, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    target = tuple(_t(y).data.shape)
+    return apply(lambda a: jnp.broadcast_to(a, target), x, name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    s = _ints(shape)
+    return apply(lambda a: jnp.broadcast_to(a, s), x, name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    xs = [_t(v) for v in inputs]
+    outs = apply(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *xs,
+                 name="broadcast_tensors")
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return apply(lambda a: a.astype(d), x, name="cast")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=ax),
+                 x, _t(index), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _gnd(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat = tuple(idx[..., i] for i in range(k))
+        return a[flat]
+    return apply(_gnd, x, _t(index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _sc(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle overwrite=False: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply(_sc, x, _t(index), _t(updates), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data = out.data
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _ints(shape)
+
+    def _snd(idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = jnp.zeros(s, upd.dtype)
+        flat = tuple(idx[..., i] for i in range(k))
+        return out.at[flat].add(upd)
+
+    return apply(_snd, _t(index), _t(updates), name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snda(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat = tuple(idx[..., i] for i in range(k))
+        return a.at[flat].add(upd)
+    return apply(_snda, x, _t(index), _t(updates), name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    def _is(a, idx):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx.astype(jnp.int32)]
+    return apply(_is, x, _t(index), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def _ia(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return apply(_ia, x, _t(index), _t(value), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_t(i).data.astype(jnp.int32) for i in indices)
+
+    def _ip(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+
+    return apply(_ip, x, _t(value), name="index_put")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                 arr, _t(indices), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def _pa(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        v = jnp.broadcast_to(v, idx.shape)
+        dims = [jnp.arange(s).reshape([-1 if d == i else 1 for d in range(a.ndim)])
+                for i, s in enumerate(idx.shape)]
+        full_idx = tuple(idx if i == axis else jnp.broadcast_to(dims[i], idx.shape)
+                         for i in range(a.ndim))
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[full_idx].multiply(v)
+        raise InvalidArgumentError(f"unknown reduce {reduce}")
+    return apply(_pa, arr, _t(indices), _t(values), name="put_along_axis")
+
+
+def slice(input, axes, starts, ends, name=None):
+    """operators/slice_op.cc parity."""
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def _slice(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            dim = out.shape[ax]
+            s2 = s + dim if s < 0 else min(s, dim)
+            e2 = e + dim if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+
+    return apply(_slice, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    strides_ = _ints(strides)
+
+    import builtins
+
+    def _ss(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides_):
+            sl[ax] = builtins.slice(s, e, st)
+        return a[tuple(sl)]
+
+    return apply(_ss, x, name="strided_slice")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """operators/shard_index_op.cc parity — used by parallel embedding
+    (reference collective.py:527 _parallel_embedding)."""
+    size = (index_num + nshards - 1) // nshards
+
+    def _shard(idx):
+        in_shard = (idx // size) == shard_id
+        return jnp.where(in_shard, idx % size, ignore_value)
+
+    return apply(_shard, input, name="shard_index")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = _t(x)
+    res = jnp.unique(x.data, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = _t(x)
+    a = np.asarray(x.data)
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0], dtype=bool)
+    keep[1:] = np.any(a[1:] != a[:-1], axis=tuple(range(1, a.ndim))) if a.ndim > 1 \
+        else a[1:] != a[:-1]
+    out = [Tensor(jnp.asarray(a[keep]))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def masked_select(x, mask, name=None):
+    """Output shape is data-dependent, so indices are computed on host; the
+    gather itself is tape-recorded so gradients flow back into x."""
+    x, mask = _t(x), _t(mask)
+    idx = np.nonzero(np.asarray(mask.data).reshape(-1))[0]
+    return apply(lambda a: a.reshape(-1)[jnp.asarray(idx)], x,
+                 name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.data if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, v, a), x, _t(mask), name="masked_fill")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """pad_op.cc / pad3d_op.cc parity. `pad` is either 2*ndim ints covering
+    every dim (np.pad order) or 2*k ints covering the spatial dims of
+    `data_format` (paddle convention: last-dim pairs first)."""
+    x = _t(x)
+    nd = x.data.ndim
+    p = _ints(pad)
+    if len(p) == 2 * nd:
+        widths = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(p) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C") and data_format.startswith("N"):  # NHWC/NDHWC/NLC
+            spatial = list(range(1, 1 + k))
+        else:  # NCHW/NCDHW/NCL
+            spatial = list(range(nd - k, nd))
+        # paddle lists pads innermost-dim first
+        for i, ax in enumerate(reversed(spatial)):
+            widths[ax] = (p[2 * i], p[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return apply(lambda a: jnp.pad(a, widths, mode="constant",
+                                       constant_values=value), x, name="pad")
+    return apply(lambda a: jnp.pad(a, widths, mode=jmode), x, name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), x, name="repeat_interleave")
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax.data).tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, _t(y),
+                 name="tensordot")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                 name="as_real")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, _t(x), name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, _t(x), name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, _t(x), name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs

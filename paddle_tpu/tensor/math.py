@@ -1,0 +1,443 @@
+"""Elementwise / reduction / matrix math ops.
+
+Reference parity: python/paddle/tensor/math.py and the C++ kernels under
+/root/reference/paddle/fluid/operators/ (activation_op.cc, elementwise/,
+reduce_ops/, matmul_v2_op.cc, cumsum_op.cc, ...). Every op is a jnp/lax
+lowering; gradients come from jax.vjp via the eager tape — there are no
+hand-written grad kernels to keep in sync (the reference maintains a grad
+op per forward op via GradOpMaker).
+
+Broadcasting follows numpy rules, which is what the reference's
+elementwise ops implement with their `axis` attribute; the legacy `axis`
+argument is accepted for the common cases.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis.data)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(v) for v in axis)
+    return int(axis)
+
+
+# --------------------------------------------------------------------------
+# binary elementwise
+# --------------------------------------------------------------------------
+
+def _binary(fname, jfn):
+    def op(x, y, name=None):
+        return apply(jfn, x, y, name=fname)
+    op.__name__ = fname
+    return op
+
+
+add = _binary("add", lambda a, b: jnp.add(a, b))
+subtract = _binary("subtract", lambda a, b: jnp.subtract(a, b))
+multiply = _binary("multiply", lambda a, b: jnp.multiply(a, b))
+divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
+floor_divide = _binary("floor_divide", lambda a, b: jnp.floor_divide(a, b))
+remainder = _binary("remainder", lambda a, b: jnp.remainder(a, b))
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", lambda a, b: jnp.power(a, b))
+maximum = _binary("maximum", lambda a, b: jnp.maximum(a, b))
+minimum = _binary("minimum", lambda a, b: jnp.minimum(a, b))
+fmax = _binary("fmax", lambda a, b: jnp.fmax(a, b))
+fmin = _binary("fmin", lambda a, b: jnp.fmin(a, b))
+atan2 = _binary("atan2", lambda a, b: jnp.arctan2(a, b))
+heaviside = _binary("heaviside", lambda a, b: jnp.heaviside(a, b))
+hypot = _binary("hypot", lambda a, b: jnp.hypot(a, b))
+logaddexp = _binary("logaddexp", lambda a, b: jnp.logaddexp(a, b))
+nextafter = _binary("nextafter", lambda a, b: jnp.nextafter(a, b))
+copysign = _binary("copysign", lambda a, b: jnp.copysign(a, b))
+gcd = _binary("gcd", lambda a, b: jnp.gcd(a, b))
+lcm = _binary("lcm", lambda a, b: jnp.lcm(a, b))
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return add(x, y)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return subtract(x, y)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return multiply(x, y)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return divide(x, y)
+
+
+# --------------------------------------------------------------------------
+# unary elementwise
+# --------------------------------------------------------------------------
+
+def _unary(fname, jfn):
+    def op(x, name=None):
+        return apply(jfn, x, name=fname)
+    op.__name__ = fname
+    return op
+
+
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", lambda a: 1.0 / a)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+i1 = _unary("i1", lambda a: jax.scipy.special.i1(a))
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+logit = _unary("logit", lambda a: jnp.log(a / (1 - a)))
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), x, name="nan_to_num")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """operators/scale_op.cc parity."""
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def _scale(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+
+    out = apply(_scale, x, name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    def _mux(idx, *arrs):
+        stacked = jnp.stack(arrs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return apply(_mux, index, *inputs, name="multiplex")
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.sum(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, name="reduce_sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim),
+                 x, name="reduce_mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim),
+                 x, name="reduce_max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim),
+                 x, name="reduce_min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.prod(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, name="reduce_prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                       keepdims=keepdim),
+                 x, name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x, name="reduce_all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x, name="reduce_any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                 x, name="count_nonzero")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def _cumsum(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return apply(_cumsum, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def _cumprod(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=d)
+        return jnp.cumprod(a, axis=int(dim), dtype=d)
+
+    return apply(_cumprod, x, name="cumprod")
+
+
+def _cum_extremum(x, axis, dtype, largest, opname):
+    """Returns (values, indices) like paddle.cummax/cummin — the running
+    extremum and the index where it was attained, via an associative scan
+    over (value, index) pairs."""
+    idx_dt = convert_dtype(dtype)
+
+    def _cm(a):
+        flat = axis is None
+        arr = a.reshape(-1) if flat else a
+        ax = 0 if flat else int(axis) % arr.ndim
+        pos = jnp.arange(arr.shape[ax]).reshape(
+            [-1 if d == ax else 1 for d in range(arr.ndim)])
+        pos = jnp.broadcast_to(pos, arr.shape)
+
+        def combine(l, r):
+            lv, li = l
+            rv, ri = r
+            take_r = rv >= lv if largest else rv <= lv
+            return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+        vals, idx = jax.lax.associative_scan(combine, (arr, pos), axis=ax)
+        return vals, idx.astype(idx_dt)
+
+    return apply(_cm, x, name=opname)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, dtype, True, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extremum(x, axis, dtype, False, "cummin")
+
+
+def add_n(inputs, name=None):
+    """operators/sum_op.cc parity."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *arrs: jax.tree_util.tree_reduce(jnp.add, list(arrs)),
+                 *inputs, name="add_n")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend.data if isinstance(prepend, Tensor) else prepend
+    app = append.data if isinstance(append, Tensor) else append
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 x, name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 x, name="trace")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def kron(x, y, name=None):
+    return apply(lambda a, b: jnp.kron(a, b), x, y, name="kron")
+
+
+def inner(x, y, name=None):
+    return apply(lambda a, b: jnp.inner(a, b), x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+# --------------------------------------------------------------------------
+# matrix math — these land on the MXU; keep operands large + bf16-friendly
+# --------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """matmul_v2_op.cc parity. XLA maps this to MXU dot_general; the
+    transpose flags become dot dimension numbers rather than materialized
+    transposes."""
+
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(_mm, x, y, name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.einsum("bij,bjk->bik", a, b), x, y, name="bmm")
+
+
+def dot(x, y, name=None):
+    def _dot(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+    return apply(_dot, x, y, name="dot")
+
+
+def mv(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b), x, y, name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, name="addmm")
+
+
+# --------------------------------------------------------------------------
+# stats
+# --------------------------------------------------------------------------
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim),
+                 x, name="nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    d = convert_dtype(dtype)
+    return apply(lambda a: jnp.nansum(a, axis=ax, dtype=d, keepdims=keepdim),
+                 x, name="nansum")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    qv = q.data if isinstance(q, Tensor) else q
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=ax,
+                                        keepdims=keepdim), x, name="quantile")
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    x = _t(x)
+    a = x.data
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(a)), float(jnp.max(a)))
+    hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(hist)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _t(x)
+    w = weights.data if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.bincount(x.data, weights=w, minlength=minlength))

@@ -1,0 +1,145 @@
+"""Random sampling ops.
+
+Reference parity: python/paddle/tensor/random.py (uniform_random_op.cc,
+gaussian_random_op.cc, randint_op.cc, randperm_op.cc, bernoulli_op.cc,
+multinomial_op.cc). The reference uses stateful per-device cuRAND; here
+keys come from core.random (global generator in eager mode, explicit key
+stack under tracing — see core/random.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.random import next_key
+from ..core.tensor import Tensor, to_tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _fdt(dtype):
+    d = convert_dtype(dtype)
+    return d if d is not None else default_float_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _fdt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(
+        jax.random.key(seed) if seed else next_key(),
+        x.data.shape, x.data.dtype, minval=float(min), maxval=float(max))
+    return x
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _fdt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), out_shape,
+                                                default_float_dtype()))
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape),
+                                                 default_float_dtype()))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (mean + std * jax.random.normal(next_key(), x.data.shape,
+                                              x.data.dtype))
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape),
+                                                 _fdt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low),
+                                     int(high), convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), int(low),
+                                     int(high)).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(
+        convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0, name=None):
+    return Tensor(jax.random.permutation(next_key(), x.data, axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jax.random.bernoulli(next_key(), x.data).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(next_key(), p, x.data.shape).astype(x.dtype)
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    logits = jnp.log(jnp.clip(x.data, 1e-30, None))
+    if x.data.ndim == 1:
+        out = jax.random.choice(next_key(), x.data.shape[0], (num_samples,),
+                                replace=replacement, p=x.data / x.data.sum())
+        return Tensor(out.astype(jnp.int64))
+    n = x.data.shape[1]
+    keys = jax.random.split(next_key(), x.data.shape[0])
+    sample_row = jax.vmap(
+        lambda k, p: jax.random.choice(k, n, (num_samples,),
+                                       replace=replacement, p=p / p.sum()))
+    return Tensor(sample_row(keys, x.data).astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jax.random.poisson(next_key(), x.data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(next_key(), x.data.shape, x.data.dtype)
+               / lam)
+    return x
+
+
+def binomial(count, prob, name=None):
+    c = count.data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob.data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(next_key(), c.astype(jnp.float32),
+                                      p).astype(jnp.int64))

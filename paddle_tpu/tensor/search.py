@@ -1,0 +1,138 @@
+"""Search / sort / selection ops.
+
+Reference parity: python/paddle/tensor/search.py (arg_min_max_op,
+top_k_v2_op.cc, argsort_op.cc, where_op.cc, masked_select_op.cc, ...).
+top_k uses jax.lax.top_k which XLA lowers to a TPU-efficient partial sort.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return apply(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(d),
+                 x, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return apply(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(d),
+                 x, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _as(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or descending)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+    return apply(_as, x, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _sort(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return apply(_sort, x, name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def _topk(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+
+    vals, idx = apply(_topk, x, name="top_k")
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax)
+        v = jnp.take(srt, k - 1, axis=ax)
+        i = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i
+    return apply(_kth, x, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    a = np.asarray(x.data)
+    ax = axis % a.ndim
+    from scipy import stats as _stats  # scipy ships with the jax dep tree
+    vals = _stats.mode(a, axis=ax, keepdims=True).mode
+    # paddle returns the LAST index equal to the mode along axis
+    eq = a == vals
+    n = a.shape[ax]
+    pos = np.arange(n).reshape([-1 if d == ax else 1 for d in range(a.ndim)])
+    idx = np.max(np.where(eq, pos, -1), axis=ax, keepdims=True)
+    if not keepdim:
+        vals = np.squeeze(vals, axis=ax)
+        idx = np.squeeze(idx, axis=ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx, dtype=jnp.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), _t(condition), x, y,
+                 name="where")
+
+
+def nonzero(x, as_tuple=False):
+    x = _t(x)
+    idx = np.nonzero(np.asarray(x.data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=jnp.int64)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1), dtype=jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+                 _t(sorted_sequence), _t(values), name="searchsorted")
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

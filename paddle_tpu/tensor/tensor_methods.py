@@ -1,0 +1,109 @@
+"""Attach the op library to Tensor as methods + operator overloads.
+
+Reference parity: python/paddle/fluid/dygraph/varbase_patch_methods.py and
+math_op_patch.py — the reference monkey-patches VarBase with generated
+methods; here the same pattern binds the functional op library.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import (attribute, creation, einsum as einsum_mod, linalg, logic, math,
+               manipulation, random, search)
+
+_METHOD_SOURCES = [math, manipulation, logic, search, linalg, attribute,
+                   creation, random]
+
+# functions whose first arg is the tensor -> safe to expose as methods
+_SKIP = {
+    "to_tensor", "zeros", "ones", "full", "arange", "linspace", "logspace",
+    "eye", "empty", "meshgrid", "tril_indices", "triu_indices", "assign",
+    "rand", "randn", "randint", "randperm", "uniform", "normal", "gaussian",
+    "standard_normal", "shape", "scatter_nd", "broadcast_shape", "complex",
+    "binomial",
+}
+
+
+def _bind():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    Tensor.einsum = None  # not a method
+    del Tensor.einsum
+
+
+_bind()
+
+# paddle-style extra method aliases
+Tensor.mean = math.mean
+Tensor.sum = math.sum
+Tensor.max = math.max
+Tensor.min = math.min
+Tensor.matmul = math.matmul
+Tensor.mm = math.mm
+Tensor.abs = math.abs
+Tensor.pow = math.pow
+Tensor.add = math.add
+Tensor.subtract = math.subtract
+Tensor.multiply = math.multiply
+Tensor.divide = math.divide
+Tensor.reshape = manipulation.reshape
+Tensor.reshape_ = manipulation.reshape_
+Tensor.transpose = manipulation.transpose
+Tensor.flatten = manipulation.flatten
+Tensor.squeeze = manipulation.squeeze
+Tensor.unsqueeze = manipulation.unsqueeze
+Tensor.split = manipulation.split
+Tensor.chunk = manipulation.chunk
+Tensor.gather = manipulation.gather
+Tensor.tile = manipulation.tile
+Tensor.expand = manipulation.expand
+Tensor.topk = search.topk
+Tensor.argmax = search.argmax
+Tensor.argmin = search.argmin
+Tensor.argsort = search.argsort
+Tensor.sort = search.sort
+Tensor.norm = linalg.norm
+
+
+# ---- operator overloads (reference math_op_patch.py) ----------------------
+
+def _swap(fn):
+    def op(self, other):
+        return fn(other, self)
+    return op
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = _swap(math.add)
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _swap(math.subtract)
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = _swap(math.multiply)
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _swap(math.divide)
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _swap(math.floor_divide)
+Tensor.__mod__ = math.remainder
+Tensor.__rmod__ = _swap(math.remainder)
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _swap(math.pow)
+Tensor.__matmul__ = math.matmul
+Tensor.__rmatmul__ = _swap(math.matmul)
+Tensor.__neg__ = math.neg
+Tensor.__abs__ = math.abs
+Tensor.__invert__ = logic.logical_not
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__and__ = logic.logical_and
+Tensor.__or__ = logic.logical_or
+Tensor.__xor__ = logic.logical_xor

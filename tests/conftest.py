@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Mirrors the reference CI strategy (SURVEY.md §4): everything runs on host
+devices so the suite is hermetic; multi-chip sharding is exercised on a
+virtual 8-device CPU mesh (XLA_FLAGS host-platform device count), the same
+way the reference tests Fleet transforms without a cluster.
+
+Must set env BEFORE jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Numeric-grad checks need exact fp32 matmuls (the backend's default
+# precision is bf16-pass based, fine for training, too loose for OpTest).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,61 @@
+"""OpTest-style harness: numeric gradient checking for eager ops.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:232 —
+`check_output` compares op output to a numpy reference and `check_grad`
+compares tape gradients against central finite differences
+(get_numeric_gradient, op_test.py:101).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn_np, inputs, wrt, eps=1e-3):
+    """Central finite differences of scalar-valued fn_np w.r.t inputs[wrt]."""
+    base = [np.array(a, dtype=np.float64) for a in inputs]
+    g = np.zeros_like(base[wrt])
+    it = np.nditer(base[wrt], flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = base[wrt][idx]
+        base[wrt][idx] = orig + eps
+        f1 = float(fn_np(*base))
+        base[wrt][idx] = orig - eps
+        f2 = float(fn_np(*base))
+        base[wrt][idx] = orig
+        g[idx] = (f1 - f2) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(fn, fn_np, inputs, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """fn: paddle op over Tensors returning a Tensor (any shape; summed to
+    scalar). fn_np: numpy equivalent. Checks every input's gradient."""
+    tensors = [paddle.to_tensor(np.asarray(a, dtype=np.float32),
+                                stop_gradient=False) for a in inputs]
+    out = fn(*tensors)
+    loss = out.sum() if out.size != 1 else out
+    loss.backward()
+
+    def scalar_np(*arrs):
+        return np.sum(fn_np(*arrs))
+
+    for i, t in enumerate(tensors):
+        assert t.grad is not None, f"input {i} got no gradient"
+        num = numeric_grad(scalar_np, [np.asarray(a) for a in inputs], i, eps)
+        np.testing.assert_allclose(
+            t.grad.numpy().astype(np.float64), num, rtol=rtol, atol=atol,
+            err_msg=f"analytic vs numeric grad mismatch for input {i}")
+
+
+def check_output(fn, fn_np, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    tensors = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = fn_np(*[np.asarray(a) for a in inputs])
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(out.numpy(), ref, rtol=rtol, atol=atol)

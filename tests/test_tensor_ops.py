@@ -1,0 +1,340 @@
+"""Op unit tests in the reference's OpTest style (numeric grad checks).
+
+Reference model: unittests/test_activation_op.py, test_elementwise_*_op.py,
+test_matmul_v2_op.py, test_reduce_op.py, ...
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+class TestElementwise:
+    def test_add_broadcast(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4).astype(np.float32)
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, np.add, [a, b])
+
+    def test_sub_mul_div(self):
+        a = np.random.rand(2, 3).astype(np.float32) + 0.5
+        b = np.random.rand(2, 3).astype(np.float32) + 0.5
+        check_grad(paddle.subtract, np.subtract, [a, b])
+        check_grad(paddle.multiply, np.multiply, [a, b])
+        check_grad(paddle.divide, np.true_divide, [a, b])
+
+    def test_pow_scalar_ops(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 0.5
+        x = paddle.to_tensor(a, stop_gradient=False)
+        y = (x ** 2 + 3 * x - 1) / 2
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), (2 * a + 3) / 2, rtol=1e-5)
+
+    def test_maximum_minimum(self):
+        a = np.random.rand(5).astype(np.float32)
+        b = np.random.rand(5).astype(np.float32)
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+
+class TestActivationsMath:
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.exp, np.exp), (paddle.tanh, np.tanh),
+        (paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+        (paddle.sqrt, np.sqrt), (paddle.log, np.log),
+        (paddle.sin, np.sin), (paddle.cos, np.cos),
+    ])
+    def test_unary_grad(self, pfn, nfn):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_grad(pfn, nfn, [a])
+
+    def test_clip(self):
+        a = np.linspace(-2, 2, 10).astype(np.float32)
+        check_output(lambda x: paddle.clip(x, -1, 1),
+                     lambda x: np.clip(x, -1, 1), [a])
+
+
+class TestReduce:
+    def test_sum_axis(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        check_output(lambda x: paddle.sum(x, axis=1),
+                     lambda x: np.sum(x, axis=1), [a])
+        check_grad(lambda x: paddle.sum(x, axis=[0, 2]),
+                   lambda x: np.sum(x, axis=(0, 2)), [a])
+
+    def test_mean_keepdim(self):
+        a = np.random.rand(2, 5).astype(np.float32)
+        check_output(lambda x: paddle.mean(x, axis=1, keepdim=True),
+                     lambda x: np.mean(x, axis=1, keepdims=True), [a])
+        check_grad(paddle.mean, np.mean, [a])
+
+    def test_max_min_prod(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.max(x, axis=0),
+                     lambda x: np.max(x, axis=0), [a])
+        check_output(lambda x: paddle.prod(x, axis=1),
+                     lambda x: np.prod(x, axis=1), [a])
+
+    def test_cumsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, axis=1), [a])
+        check_grad(lambda x: paddle.cumsum(x, axis=0),
+                   lambda x: np.cumsum(x, axis=0), [a])
+
+    def test_logsumexp_std_var(self):
+        a = np.random.rand(4, 4).astype(np.float32)
+        from scipy.special import logsumexp as np_lse
+        check_output(lambda x: paddle.logsumexp(x, axis=1),
+                     lambda x: np_lse(x, axis=1), [a], rtol=1e-4, atol=1e-4)
+        check_output(lambda x: paddle.std(x),
+                     lambda x: np.std(x, ddof=1), [a], rtol=1e-4, atol=1e-5)
+
+
+class TestMatmul:
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [a, b])
+        check_grad(paddle.matmul, np.matmul, [a, b])
+
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        b = np.random.rand(5, 4).astype(np.float32)
+        check_output(lambda x, y: paddle.matmul(x, y, True, True),
+                     lambda x, y: x.T @ y.T, [a, b])
+
+    def test_bmm(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        check_output(paddle.bmm, np.matmul, [a, b])
+        check_grad(paddle.bmm, np.matmul, [a, b], rtol=2e-2)
+
+    def test_einsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose_grad(self):
+        a = np.random.rand(2, 6).astype(np.float32)
+        check_grad(lambda x: paddle.reshape(x, [3, 4]),
+                   lambda x: np.reshape(x, [3, 4]), [a])
+        check_output(lambda x: paddle.transpose(x, [1, 0]),
+                     lambda x: x.T, [a])
+
+    def test_concat_split(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        parts = paddle.split(out, 2, axis=0)
+        np.testing.assert_allclose(parts[0].numpy(), a)
+        parts = paddle.split(out, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_concat_grad_flows_to_all(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        paddle.concat([a, b], axis=1).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones((2, 2)))
+        np.testing.assert_allclose(b.grad.numpy(), np.ones((2, 2)))
+
+    def test_squeeze_unsqueeze_stack(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(paddle.to_tensor(a)).shape == [3]
+        assert paddle.unsqueeze(paddle.to_tensor(a), [0]).shape == [1, 1, 3, 1]
+        s = paddle.stack([paddle.ones([2]), paddle.zeros([2])], axis=0)
+        assert s.shape == [2, 2]
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        check_output(lambda x: paddle.gather(x, paddle.to_tensor(idx)),
+                     lambda x: x[idx], [a])
+        upd = np.ones((2, 3), np.float32) * 9
+        out = paddle.scatter(paddle.to_tensor(a), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_gather_nd(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.array([[0, 1], [1, 2]])
+        out = paddle.gather_nd(paddle.to_tensor(a), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), a[[0, 1], [1, 2]])
+
+    def test_tile_expand_flip(self):
+        a = np.random.rand(1, 3).astype(np.float32)
+        assert paddle.tile(paddle.to_tensor(a), [2, 2]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(a), [4, 3]).shape == [4, 3]
+        check_output(lambda x: paddle.flip(x, [1]),
+                     lambda x: np.flip(x, 1), [a])
+
+    def test_getitem_grad(self):
+        a = paddle.to_tensor(np.arange(9, np.float32).reshape(3, 3)
+                             if False else np.arange(9, dtype=np.float32).reshape(3, 3),
+                             stop_gradient=False)
+        a[1:, :2].sum().backward()
+        ref = np.zeros((3, 3))
+        ref[1:, :2] = 1
+        np.testing.assert_allclose(a.grad.numpy(), ref)
+
+
+class TestSearchLogic:
+    def test_argmax_sort_topk(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        check_output(lambda x: paddle.argmax(x, axis=1),
+                     lambda x: np.argmax(x, axis=1), [a])
+        check_output(lambda x: paddle.sort(x, axis=1),
+                     lambda x: np.sort(x, axis=1), [a])
+        vals, idx = paddle.topk(paddle.to_tensor(a), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(),
+                                   -np.sort(-a, axis=1)[:, :2], rtol=1e-6)
+
+    def test_where_nonzero(self):
+        a = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        out = paddle.where(paddle.to_tensor(a > 0), paddle.to_tensor(a),
+                           paddle.to_tensor(-a))
+        np.testing.assert_allclose(out.numpy(), np.abs(a))
+        nz = paddle.nonzero(paddle.to_tensor(a))
+        assert nz.shape == [2, 2]
+
+    def test_comparisons(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([3.0, 2.0, 1.0])
+        np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+        np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+        assert bool(paddle.allclose(a, a))
+
+
+class TestLinalg:
+    def test_norm(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.norm(x),
+                     lambda x: np.linalg.norm(x), [a], rtol=1e-5)
+        check_output(lambda x: paddle.norm(x, p=1, axis=1),
+                     lambda x: np.abs(x).sum(1), [a], rtol=1e-5)
+
+    def test_cholesky_inv_solve(self):
+        a = np.random.rand(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-4,
+                                   atol=1e-4)
+        inv = paddle.linalg.inv(paddle.to_tensor(spd))
+        np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), atol=1e-4)
+        b = np.random.rand(4, 2).astype(np.float32)
+        x = paddle.linalg.solve(paddle.to_tensor(spd), paddle.to_tensor(b))
+        np.testing.assert_allclose(spd @ x.numpy(), b, atol=1e-4)
+
+
+class TestCreationRandom:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], "int32").dtype == np.int32
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+        assert paddle.eye(3).numpy().trace() == 3
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_random_reproducible(self):
+        paddle.seed(42)
+        a = paddle.rand([4])
+        paddle.seed(42)
+        b = paddle.rand([4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        u = paddle.uniform([1000], min=-2, max=2)
+        assert -2 <= float(u.min()) and float(u.max()) <= 2
+        r = paddle.randint(0, 10, [100])
+        assert 0 <= int(r.min()) and int(r.max()) < 10
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+class TestAutogradEngine:
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x          # used twice below
+        z = y + y * 3.0
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [16.0])  # d/dx 4x^2
+
+    def test_grad_accumulation_across_backwards(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0] * 3)
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = (x * 2).sum()
+        assert y.stop_gradient
+        assert y._creator is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2).detach()
+        (y * 3).sum()
+        assert y.stop_gradient
+
+    def test_retain_graph_false_frees(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward()
+        import pytest as _pytest
+        with _pytest.raises(Exception):
+            y.backward()
+
+    def test_double_backward_with_retain(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0] * 3)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        w = paddle.to_tensor(np.ones(3, np.float32))  # stop_gradient=True
+        (x * w).sum().backward()
+        assert x.grad is not None and w.grad is None
+
+    def test_nan_check_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(Exception):
+                paddle.log(x * 0 - 1)  # log(-1) -> nan
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestTensorMethods:
+    def test_methods_and_repr(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2] and x.ndim == 2 and x.numel() == 4
+        assert abs(float(x.mean()) - 2.5) < 1e-6
+        assert x.astype("int32").dtype == np.int32
+        assert "Tensor" in repr(x)
+        assert x.T.shape == [2, 2]
+        np.testing.assert_allclose(x.t().numpy(), x.numpy().T)
+
+    def test_item_and_setitem(self):
+        x = paddle.to_tensor([[1.0, 2.0]])
+        assert x[0, 1].item() == 2.0
+        x[0, 0] = 9.0
+        assert x[0, 0].item() == 9.0
+
+    def test_cast_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = x.astype("bfloat16").astype("float32").sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3))

@@ -1,0 +1,79 @@
+"""Regression tests for review findings + extra op coverage."""
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestPytreeStability:
+    def test_same_shape_tensors_share_treedef(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        assert (jax.tree_util.tree_structure(a)
+                == jax.tree_util.tree_structure(b))
+        out = jax.tree.map(lambda x, y: x + y, a, b)
+        np.testing.assert_allclose(np.asarray(out.data), [4.0, 6.0])
+
+    def test_jit_no_retrace(self):
+        traces = []
+
+        @jax.jit
+        def f(t):
+            traces.append(1)
+            return t.data * 2
+
+        f(paddle.to_tensor([1.0]))
+        f(paddle.to_tensor([2.0]))
+        f(paddle.to_tensor([3.0]))
+        assert len(traces) == 1
+
+
+class TestFixedOps:
+    def test_mode(self):
+        vals, idx = paddle.mode(paddle.to_tensor(
+            np.array([[1.0, 1.0, 2.0], [3.0, 4.0, 4.0]])), axis=1)
+        np.testing.assert_allclose(vals.numpy(), [1.0, 4.0])
+        np.testing.assert_array_equal(idx.numpy(), [1, 2])
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        out = paddle.pad(x, [1, 1, 0, 0])  # pad W by 1 each side (NCHW)
+        assert out.shape == [1, 1, 2, 4]
+        out = paddle.pad(paddle.ones([2, 2]), [0, 1, 1, 0], value=5.0)
+        assert out.shape == [3, 3]
+        assert out.numpy()[0, 0] == 5.0
+
+    def test_masked_select_grad(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32),
+                             stop_gradient=False)
+        mask = paddle.to_tensor(np.array([True, False, True, False]))
+        paddle.masked_select(x, mask).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0, 0.0])
+
+    def test_cummax_cummin(self):
+        x = paddle.to_tensor(np.array([[1.0, 3.0, 2.0], [4.0, 0.0, 5.0]]))
+        vals, idx = paddle.cummax(x, axis=1)
+        np.testing.assert_allclose(vals.numpy(), [[1, 3, 3], [4, 4, 5]])
+        np.testing.assert_array_equal(idx.numpy(), [[0, 1, 1], [0, 0, 2]])
+        vals, idx = paddle.cummin(x, axis=1)
+        np.testing.assert_allclose(vals.numpy(), [[1, 1, 1], [4, 0, 0]])
+
+    def test_multinomial_batched(self):
+        probs = paddle.to_tensor(np.eye(4, dtype=np.float32) + 1e-9)
+        out = paddle.multinomial(probs, 2, replacement=True)
+        assert out.shape == [4, 2]
+        np.testing.assert_array_equal(out.numpy()[:, 0], [0, 1, 2, 3])
+
+    def test_householder_product_batched(self):
+        a = np.random.rand(2, 4, 3).astype(np.float32)
+        tau = np.random.rand(2, 3).astype(np.float32)
+        out = paddle.linalg.householder_product(
+            paddle.to_tensor(a), paddle.to_tensor(tau))
+        assert out.shape == [2, 4, 3]
+
+    def test_shard_index(self):
+        x = paddle.to_tensor(np.array([1, 5, 9, 3]))
+        out = paddle.shard_index(x, index_num=10, nshards=2, shard_id=0)
+        np.testing.assert_array_equal(out.numpy(), [1, -1, -1, 3])
+        out = paddle.shard_index(x, index_num=10, nshards=2, shard_id=1)
+        np.testing.assert_array_equal(out.numpy(), [-1, 0, 4, -1])
