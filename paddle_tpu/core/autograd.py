@@ -93,14 +93,16 @@ class GradNode:
     """One recorded op application. vjp_fn maps output cotangents ->
     input cotangents (aligned with `inputs`)."""
 
-    __slots__ = ("id", "vjp_fn", "inputs", "out_avals", "name", "__weakref__")
+    __slots__ = ("id", "vjp_fn", "inputs", "out_avals", "name", "multi",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, name="", multi=False):
         self.id = next(_node_counter)
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor]
         self.out_avals = out_avals  # list[(shape, dtype)]
         self.name = name
+        self.multi = multi  # forward returned a tuple/list (even of len 1)
 
     def __repr__(self):
         return f"<GradNode {self.name or 'op'} id={self.id}>"
@@ -149,6 +151,7 @@ def apply(fn, *args, name: str = ""):
             tensor_inputs,
             [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs],
             name=name or getattr(fn, "__name__", ""),
+            multi=multi,
         )
         wrapped = tuple(
             Tensor(o, stop_gradient=False, _creator=(node, i))
@@ -209,7 +212,9 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
             raise PreconditionNotMetError(
                 "Trying to backward through the graph a second time; "
                 "set retain_graph=True if you need to.")
-        out = cots[0] if len(cots) == 1 else tuple(cots)
+        # cotangent structure must mirror the forward output structure
+        # exactly (a 1-element tuple output needs a 1-element tuple cot)
+        out = tuple(cots) if node.multi else cots[0]
         in_grads = node.vjp_fn(out)
         if not retain_graph:
             node.vjp_fn = None

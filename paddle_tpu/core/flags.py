@@ -55,7 +55,13 @@ class FlagRegistry:
             if f.validator is not None and not f.validator(value):
                 from .errors import InvalidArgumentError
                 raise InvalidArgumentError(f"Invalid value {value!r} for flag {name}")
-            self._values[name] = f.type(value) if not isinstance(value, f.type) else value
+            if isinstance(value, f.type):
+                self._values[name] = value
+            elif isinstance(value, str):
+                # same semantics as env parsing: "false"/"0" disable bools
+                self._values[name] = self._parse(f.type, value)
+            else:
+                self._values[name] = f.type(value)
 
     def get(self, name):
         with self._lock:

@@ -23,6 +23,9 @@ class Place:
                 and (self.device_type, self.device_id)
                 == (other.device_type, other.device_id))
 
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
     def jax_device(self):
         devs = jax.devices() if self.device_type != "cpu" else jax.devices("cpu")
         return devs[min(self.device_id, len(devs) - 1)]

@@ -1,0 +1,20 @@
+"""paddle.distributed parity, TPU-native.
+
+Reference: python/paddle/distributed/ (§2.5 of SURVEY.md). The NCCL
+ring_id world becomes a jax.sharding.Mesh whose named axes ARE the
+parallel dimensions (dp/tp/pp/sp/ep); collectives are XLA ops inside
+compiled programs, exposed eagerly through this package's API for
+dygraph-style parity.
+"""
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized)
+from .mesh import (  # noqa: F401
+    Mesh, get_mesh, set_mesh, create_mesh, mesh_axis_size)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, reduce, broadcast, scatter, barrier,
+    all_to_all, send, recv, split, ReduceOp, new_group)
+from .parallel import DataParallel  # noqa: F401
+from . import parallel_layers  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from . import fleet  # noqa: F401

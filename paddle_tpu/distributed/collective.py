@@ -1,0 +1,308 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py:101-457 (broadcast /
+all_reduce / reduce / all_gather / scatter / barrier over c_* ops with
+ring_id) and the C++ kernels operators/collective/ (SURVEY.md §2.3).
+
+TPU-native semantics: there are two worlds —
+1. COMPILED (the perf path): inside shard_map/pjit these functions lower
+   to lax.psum / all_gather / ppermute / all_to_all over mesh axis names;
+   XLA schedules them on ICI. Pass `axis_name=` (or rely on the ambient
+   mesh axis 'dp').
+2. EAGER single-process: world_size==1, every collective is the identity
+   (matching the reference's behavior for nranks==1, collective.py:139).
+   Multi-process eager collectives go through
+   jax.experimental.multihost_utils when a multi-host runtime is
+   initialized.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from . import env
+from .mesh import get_mesh
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce", "broadcast",
+           "scatter", "barrier", "all_to_all", "send", "recv", "split",
+           "new_group", "wait", "get_group"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """Communication group (reference collective.py Group w/ ring_id). On
+    TPU a group is a mesh axis name (or None = world)."""
+
+    def __init__(self, rank, nranks, id=0, axis_name=None, ranks=None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.axis_name = axis_name
+        self.ranks = ranks or list(range(nranks))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(rank={self.rank}, nranks={self.nranks}, " \
+               f"axis={self.axis_name})"
+
+
+_default_group = None
+_groups = {}
+_group_counter = 0
+
+
+def get_group(group=None) -> Group:
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group(env.get_rank(), env.get_world_size(), 0)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis_name=None) -> Group:
+    global _group_counter
+    _group_counter += 1
+    world = env.get_world_size()
+    ranks = ranks if ranks is not None else list(range(world))
+    rank = env.get_rank()
+    g = Group(ranks.index(rank) if rank in ranks else -1, len(ranks),
+              _group_counter, axis_name=axis_name, ranks=ranks)
+    _groups[_group_counter] = g
+    return g
+
+
+def _in_trace(x) -> bool:
+    arr = x.data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               axis_name=None):
+    """reference collective.py:157 all_reduce (c_allreduce_sum kernel,
+    c_allreduce_op.h:54). Compiled: psum/pmax/pmin over the mesh axis."""
+    g = get_group(group)
+    name = axis_name or (g.axis_name if g else None)
+    if _in_trace(tensor) and name is not None:
+        if op == ReduceOp.AVG:
+            return apply(lambda a: jax.lax.pmean(a, name), tensor,
+                         name="all_reduce")
+        red = _REDUCERS.get(op)
+        if red is None:
+            raise ValueError(f"unsupported reduce op {op} in traced mode")
+        return apply(lambda a: red(a, name), tensor, name="all_reduce")
+    if g.nranks <= 1:
+        return tensor
+    # multi-process eager: psum over processes via multihost utils
+    from jax.experimental import multihost_utils
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    out = multihost_utils.process_allgather(arr)
+    if op == ReduceOp.SUM:
+        red = out.sum(axis=0)
+    elif op == ReduceOp.MAX:
+        red = out.max(axis=0)
+    elif op == ReduceOp.MIN:
+        red = out.min(axis=0)
+    elif op == ReduceOp.AVG:
+        red = out.mean(axis=0)
+    else:
+        red = out.prod(axis=0)
+    if isinstance(tensor, Tensor):
+        tensor._data = jnp.asarray(red)
+        return tensor
+    return red
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True,
+               axis_name=None):
+    """reference collective.py:313 all_gather (c_allgather). Two calling
+    conventions: list-out eager parity, or functional (tensor only) which
+    returns the gathered tensor (compiled path)."""
+    if tensor is None:
+        tensor = tensor_list
+        tensor_list = None
+    g = get_group(group)
+    name = axis_name or (g.axis_name if g else None)
+    if _in_trace(tensor) and name is not None:
+        out = apply(lambda a: jax.lax.all_gather(a, name), tensor,
+                    name="all_gather")
+        return out
+    if g.nranks <= 1:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    from jax.experimental import multihost_utils
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    gathered = multihost_utils.process_allgather(arr)
+    if tensor_list is not None:
+        for i in range(gathered.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(gathered[i])))
+        return tensor_list
+    return Tensor(jnp.asarray(gathered))
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           axis_name=None):
+    """reference collective.py:231. On TPU SPMD there is no cheaper
+    'reduce to one' than allreduce (ICI is all-to-all bandwidth), so this
+    is allreduce; rank!=dst callers simply ignore the value."""
+    return all_reduce(tensor, op=op, group=group, axis_name=axis_name)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, axis_name=None):
+    """reference collective.py:101 (c_broadcast). Compiled: select the
+    src slice and broadcast via all_gather/ppermute composition — XLA has
+    no direct named-axis broadcast, psum of masked value is the idiom."""
+    g = get_group(group)
+    name = axis_name or (g.axis_name if g else None)
+    if _in_trace(tensor) and name is not None:
+        def fn(a):
+            idx = jax.lax.axis_index(name)
+            masked = jnp.where(idx == src, a, jnp.zeros_like(a))
+            return jax.lax.psum(masked, name)
+        return apply(fn, tensor, name="broadcast")
+    if g.nranks <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    out = multihost_utils.broadcast_one_to_all(arr)
+    if isinstance(tensor, Tensor):
+        tensor._data = jnp.asarray(out)
+        return tensor
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            axis_name=None):
+    """reference collective.py:386 (c_scatter)."""
+    g = get_group(group)
+    name = axis_name or (g.axis_name if g else None)
+    if _in_trace(tensor) and name is not None:
+        # compiled: dynamic-slice own shard after broadcast from src
+        def fn(a):
+            idx = jax.lax.axis_index(name)
+            n = jax.lax.psum(jnp.ones((), jnp.int32), name)
+            shard = a.shape[0] // g.nranks
+            return jax.lax.dynamic_slice_in_dim(a, idx * shard, shard, 0)
+        return apply(fn, tensor, name="scatter")
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor._data = (tensor_list[src].data
+                            if isinstance(tensor_list[src], Tensor)
+                            else jnp.asarray(tensor_list[src]))
+        return tensor
+    raise NotImplementedError(
+        "eager multi-process scatter: use broadcast + local slice")
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
+               sync_op=True, axis_name=None):
+    """All-to-all (ABSENT in the reference snapshot — SURVEY.md §2.5 marks
+    expert parallelism as new design). Compiled: lax.all_to_all over the
+    'ep' axis; this eager form handles world==1."""
+    if in_tensor_list is None:
+        # functional: single stacked tensor [n, ...] -> exchanged
+        tensor = out_tensor_list
+        g = get_group(group)
+        name = axis_name or (g.axis_name if g else None)
+        if _in_trace(tensor) and name is not None:
+            return apply(lambda a: jax.lax.all_to_all(
+                a, name, split_axis=0, concat_axis=0), tensor,
+                name="all_to_all")
+        return tensor
+    g = get_group(group)
+    if g.nranks <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError("eager multi-process all_to_all")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send (reference send_v2_op.cu.cc — pipeline boundary). In
+    compiled pipelines this is a ppermute; eager single-process is a
+    no-op paired with recv."""
+    g = get_group(group)
+    if g.nranks <= 1:
+        _p2p_buffer.append(tensor)
+        return tensor
+    raise NotImplementedError("eager multi-process send: use pipeline mesh")
+
+
+_p2p_buffer: List = []
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = get_group(group)
+    if g.nranks <= 1:
+        if _p2p_buffer:
+            val = _p2p_buffer.pop(0)
+            tensor._data = val.data if isinstance(val, Tensor) else val
+        return tensor
+    raise NotImplementedError("eager multi-process recv: use pipeline mesh")
+
+
+def barrier(group=None):
+    """reference collective.py:457 (barrier op over gloo/nccl)."""
+    g = get_group(group)
+    if g.nranks <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference c_sync_calc_stream / c_sync_comm_stream ops — on TPU XLA
+    owns scheduling; block_until_ready is the only user-visible sync."""
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style tensor-parallel layer builder (reference
+    collective.py:566 paddle.distributed.split: row/column parallel linear
+    + sharded embedding). Returns the constructed parallel layer's output;
+    prefer the explicit classes in
+    paddle_tpu.distributed.parallel_layers."""
+    from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         gather_output=gather_out,
+                                         weight_attr=weight_attr,
+                                         bias_attr=bias_attr)
+        else:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      bias_attr=bias_attr)
+        return layer(x)
+    if operation == "embedding":
+        n_emb, dim = size
+        layer = VocabParallelEmbedding(n_emb, dim, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation}")

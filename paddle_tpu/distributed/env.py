@@ -1,0 +1,73 @@
+"""Distributed environment: rank/world discovery + JAX runtime init.
+
+Reference: the env-variable contract set by fleet/launch.py
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS,
+launch_utils.py:164-258) and dygraph init_parallel_env (parallel.py:57).
+TPU-native: `jax.distributed.initialize` (coordinator rendezvous)
+replaces the TCP ncclUniqueId exchange (gen_comm_id_helper.cc); inside
+one process, "world size" for SPMD purposes is the number of addressable
+devices times the process count.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def get_rank() -> int:
+    """Process rank (reference paddle.distributed.get_rank)."""
+    for var in ("PADDLE_TRAINER_ID", "RANK", "JAX_PROCESS_INDEX"):
+        if var in os.environ:
+            return int(os.environ[var])
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    """Number of processes (reference paddle.distributed.get_world_size)."""
+    for var in ("PADDLE_TRAINERS_NUM", "WORLD_SIZE", "JAX_PROCESS_COUNT"):
+        if var in os.environ:
+            return int(os.environ[var])
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def get_current_endpoint() -> Optional[str]:
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT")
+
+
+def init_parallel_env():
+    """Multi-host JAX runtime bootstrap (reference parallel.py:57
+    init_parallel_env -> NCCLParallelContext::Init). Safe to call on a
+    single process (no-op)."""
+    global _initialized
+    if _initialized:
+        return
+    world = get_world_size()
+    if world > 1 and "JAX_COORDINATOR_ADDRESS" in os.environ or \
+            "PADDLE_MASTER" in os.environ:
+        import jax
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+            os.environ.get("PADDLE_MASTER")
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=world,
+            process_id=get_rank())
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized

@@ -1,0 +1,22 @@
+"""Fleet: the distributed-training facade.
+
+Reference: python/paddle/distributed/fleet/ (fleet_base.py:63 init /
+:594 distributed_optimizer / :1066 minimize; DistributedStrategy proto
+distributed_strategy.proto:122; meta-optimizer chain amp→recompute→
+sharding→pipeline→graph_execution).
+
+TPU-native: the meta-optimizer program-rewrite chain becomes a strategy
+bag consumed by ONE compiled train step: amp = dtype policy, recompute =
+jax.checkpoint policy, sharding = opt-state/param sharding specs (ZeRO),
+pipeline/tensor/data parallel = mesh axes. `distributed_optimizer`
+returns a wrapper that carries the strategy into
+paddle_tpu.distributed.spmd.make_train_step (the 'StrategyCompiler').
+"""
+from .base import (  # noqa: F401
+    init, is_first_worker, worker_index, worker_num, is_worker,
+    worker_endpoints, server_num, server_index, server_endpoints,
+    is_server, barrier_worker, init_worker, init_server, run_server,
+    stop_worker, distributed_optimizer, DistributedOptimizer,
+    save_persistables, save_inference_model, minimize)
+from .strategy import DistributedStrategy  # noqa: F401
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401

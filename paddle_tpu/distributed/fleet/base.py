@@ -1,0 +1,197 @@
+"""Fleet facade (reference fleet/base/fleet_base.py — init:130,
+distributed_optimizer:594, minimize:1066).
+
+The reference's minimize() rewrote the program through a chain of meta
+optimizers; here DistributedOptimizer carries the strategy and, in eager
+mode, applies the pieces that make sense per-step (grad merge, lamb/lars
+swap); compiled trainers read the same strategy through
+paddle_tpu.distributed.spmd.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import env
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy import DistributedStrategy
+
+_role_maker: Optional[RoleMakerBase] = None
+_user_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    """fleet.init parity (fleet_base.py:130)."""
+    global _role_maker, _user_strategy
+    _role_maker = role_maker or PaddleCloudRoleMaker(
+        is_collective=is_collective)
+    _user_strategy = strategy or DistributedStrategy()
+    env.init_parallel_env()
+    return None
+
+
+def _rm() -> RoleMakerBase:
+    global _role_maker
+    if _role_maker is None:
+        init()
+    return _role_maker
+
+
+def is_first_worker():
+    return _rm()._is_first_worker()
+
+
+def worker_index():
+    return _rm()._worker_index()
+
+
+def worker_num():
+    return _rm()._worker_num()
+
+
+def is_worker():
+    return _rm()._is_worker()
+
+
+def worker_endpoints(to_string=False):
+    eps = _rm()._get_trainer_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def server_num():
+    return _rm()._server_num()
+
+
+def server_index():
+    return _rm()._server_index()
+
+
+def server_endpoints(to_string=False):
+    eps = _rm()._get_pserver_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def is_server():
+    return _rm()._is_server()
+
+
+def barrier_worker():
+    _rm()._barrier()
+
+
+def init_worker():
+    pass
+
+
+def init_server(*args, **kwargs):
+    pass
+
+
+def run_server():
+    raise NotImplementedError(
+        "parameter-server mode: TPU training is collective-only; "
+        "PS workloads map to sharded embedding + collective training "
+        "(see paddle_tpu.distributed.parallel_layers.VocabParallelEmbedding)")
+
+
+def stop_worker():
+    pass
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      mode=0):
+    raise NotImplementedError(
+        "use paddle_tpu.save(model.state_dict(), path) or "
+        "paddle_tpu.distributed.checkpoint for sharded arrays")
+
+
+def save_inference_model(*args, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save to export a compiled inference function")
+
+
+class DistributedOptimizer:
+    """Wraps a user optimizer with the DistributedStrategy (reference
+    fleet_base.py:594 distributed_optimizer + the meta-opt chain applied
+    in minimize:1066)."""
+
+    def __init__(self, optimizer, strategy: Optional[DistributedStrategy]):
+        self.inner_opt = optimizer
+        self.user_defined_strategy = strategy or _user_strategy or \
+            DistributedStrategy()
+        self._grad_merge_count = 0
+        self._swap_large_batch_opt()
+
+    def _swap_large_batch_opt(self):
+        """lamb/lars strategy flags swap the update rule (reference
+        lamb_optimizer.py/lars_optimizer.py meta-opts)."""
+        from ... import optimizer as opt_mod
+        s = self.user_defined_strategy
+        inner = self.inner_opt
+        if s.lamb and isinstance(inner, opt_mod.Momentum) is False and \
+                not isinstance(inner, opt_mod.Lamb):
+            cfg = s.lamb_configs
+            self.inner_opt = opt_mod.Lamb(
+                learning_rate=inner._lr,
+                lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                parameters=inner._parameters,
+                grad_clip=inner._grad_clip)
+        elif s.lars and isinstance(inner, opt_mod.Momentum):
+            cfg = s.lars_configs
+            self.inner_opt = opt_mod.Lars(
+                learning_rate=inner._lr,
+                momentum=inner._momentum,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                parameters=inner._parameters,
+                grad_clip=inner._grad_clip)
+
+    def get_lr(self):
+        return self.inner_opt.get_lr()
+
+    def step(self):
+        s = self.user_defined_strategy
+        if s.gradient_merge:
+            k = s.gradient_merge_configs.get("k_steps", 1)
+            self._grad_merge_count += 1
+            if self._grad_merge_count % k != 0:
+                return  # accumulate: grads stay on params
+            if s.gradient_merge_configs.get("avg", True):
+                for p in self.inner_opt._parameters or []:
+                    if p.grad is not None:
+                        p.grad._data = p.grad.data / k
+        self.inner_opt.step()
+        if s.gradient_merge:
+            self.inner_opt.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        s = self.user_defined_strategy
+        if s.gradient_merge and \
+                self._grad_merge_count % s.gradient_merge_configs.get(
+                    "k_steps", 1) != 0:
+            return  # keep accumulating
+        self.inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_opt"], name)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return DistributedOptimizer(optimizer, strategy)
+
+
+def minimize(loss, **kwargs):
+    raise RuntimeError("call fleet.distributed_optimizer(...).minimize")

@@ -1,0 +1,111 @@
+"""Role makers: rank/role discovery from environment.
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py:528
+(PaddleCloudRoleMaker — PADDLE_* env contract), :875 (UserDefinedRoleMaker).
+The gloo rendezvous (role_maker.py:120-138) is replaced by the JAX
+coordinator (env.init_parallel_env)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _worker_index(self):
+        return 0
+
+    def _worker_num(self):
+        return 1
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _server_num(self):
+        return 0
+
+    def _server_index(self):
+        return 0
+
+    def _get_trainer_endpoints(self):
+        return []
+
+    def _get_pserver_endpoints(self):
+        return []
+
+    def _barrier(self, comm_world=None):
+        from .. import collective
+        collective.barrier()
+
+    def _generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._generate_role()
+
+    def _generate_role(self):
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        pseps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = pseps.split(",") if pseps else []
+        role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        if self._role == Role.SERVER:
+            self._server_id = int(os.environ.get("PADDLE_PORT_ID", "0"))
+
+    def _worker_index(self):
+        return self._trainer_id
+
+    def _worker_num(self):
+        return self._trainers_num
+
+    def _server_num(self):
+        return len(self._server_endpoints)
+
+    def _server_index(self):
+        return getattr(self, "_server_id", 0)
+
+    def _get_trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    def _get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._init_kwargs = kwargs
+        super().__init__(is_collective, **kwargs)
+
+    def _generate_role(self):
+        kw = self._init_kwargs
+        self._trainer_id = kw.get("current_id", 0)
+        self._trainers_num = kw.get("worker_num",
+                                    len(kw.get("worker_endpoints", [1])))
+        self._trainer_endpoints = kw.get("worker_endpoints", [])
+        self._server_endpoints = kw.get("server_endpoints", [])
+        role = kw.get("role", Role.WORKER)
+        self._role = role

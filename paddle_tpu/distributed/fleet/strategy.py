@@ -1,0 +1,118 @@
+"""DistributedStrategy (reference
+python/paddle/distributed/fleet/base/distributed_strategy.py:104 over
+proto framework/distributed_strategy.proto:122-166).
+
+Same knob surface, proto replaced by a plain config object (TPU has no
+program rewrite passes to configure — the knobs feed the compiled train
+step builder instead)."""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULTS: Dict[str, Any] = {
+    # mirrored from distributed_strategy.proto (field: default)
+    "amp": False,
+    "amp_configs": {
+        "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True, "custom_white_list": [],
+        "custom_black_list": [], "use_pure_fp16": False,
+        "use_bf16": True,  # TPU-native default: bf16 needs no loss scaling
+    },
+    "recompute": False,
+    "recompute_configs": {"checkpoints": [], "policy": "dots"},
+    "sharding": False,
+    "sharding_configs": {"sharding_group_size": 8, "stage": 2,
+                         "hybrid_dp": False, "fuse_broadcast_MB": 32.0},
+    "pipeline": False,
+    "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1,
+                         "schedule_mode": "F-then-B"},
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "sequence_parallel": False,
+    "sequence_parallel_configs": {"degree": 1, "mode": "ring"},
+    "expert_parallel": False,
+    "expert_parallel_configs": {"degree": 1, "capacity_factor": 1.25},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "lars": False,
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "dgc": False,
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "a_sync": False,
+    "a_sync_configs": {"k_steps": -1},
+    "elastic": False,
+    "auto": False,
+    "fp16_allreduce": False,
+    "find_unused_parameters": False,
+    "nccl_comm_num": 1,
+    "hierarchical_allreduce_inter_nranks": 1,
+    "use_hierarchical_allreduce": False,
+    "fuse_grad_size_in_MB": 32,
+    "last_comm_group_size_MB": 1,
+    "fuse_all_reduce_ops": True,
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._conf = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        conf = object.__getattribute__(self, "_conf")
+        if name in conf:
+            return conf[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name == "_conf":
+            object.__setattr__(self, name, value)
+            return
+        if name not in self._conf:
+            raise AttributeError(f"unknown strategy field {name!r}")
+        cur = self._conf[name]
+        if isinstance(cur, dict) and isinstance(value, dict):
+            cur.update(value)
+        else:
+            self._conf[name] = value
+
+    # parity helpers
+    def to_dict(self):
+        return copy.deepcopy(self._conf)
+
+    def save_to_prototxt(self, path):
+        with open(path, "w") as f:
+            json.dump(self._conf, f, indent=2, default=str)
+
+    def load_from_prototxt(self, path):
+        with open(path) as f:
+            self._conf.update(json.load(f))
+
+    def __repr__(self):
+        on = [k for k, v in self._conf.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"
+
+
+# hybrid parallel degree helper used by fleet.init(is_collective=True)
+def hybrid_degrees(strategy: DistributedStrategy):
+    tp = strategy.tensor_parallel_configs.get("tensor_parallel_degree", 1) \
+        if strategy.tensor_parallel else 1
+    pp = strategy.pipeline_configs.get("accumulate_steps", 1) and \
+        strategy.pipeline_configs.get("pp_degree", 1) \
+        if strategy.pipeline else 1
+    sp = strategy.sequence_parallel_configs.get("degree", 1) \
+        if strategy.sequence_parallel else 1
+    ep = strategy.expert_parallel_configs.get("degree", 1) \
+        if strategy.expert_parallel else 1
+    return {"tp": tp or 1, "pp": pp or 1, "sp": sp, "ep": ep}

@@ -1,0 +1,86 @@
+"""Device mesh management.
+
+Reference mapping (SURVEY.md §5/§7): the reference's ring_id->NCCLComm
+registry (collective_helper.h:65) + per-parallel-dimension rings
+(sharding/dp/pp pairs, pipeline_optimizer.py:136) become ONE
+jax.sharding.Mesh with named axes; a "ring" is just a mesh axis name, and
+XLA lowers collectives over the right ICI links from the device
+assignment. Axis-name conventions used across the framework:
+
+    dp - data parallel          tp - tensor model parallel
+    pp - pipeline stages        sp - sequence/context parallel
+    ep - expert parallel
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "create_mesh",
+           "get_mesh", "set_mesh", "mesh_axis_size", "default_mesh"]
+
+_current_mesh: Optional[Mesh] = None
+
+
+def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
+                axis_names: Optional[Sequence[str]] = None,
+                devices=None) -> Mesh:
+    """Build a Mesh from {'dp': 2, 'tp': 4} style spec. -1 for one axis
+    means 'all remaining devices'."""
+    if isinstance(axes, dict):
+        names = list(axes.keys())
+        shape = list(axes.values())
+    else:
+        shape = list(axes)
+        names = list(axis_names or [f"axis{i}" for i in range(len(shape))])
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    total = int(np.prod(shape))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, shape))} needs {total} "
+                         f"devices, only {n} available")
+    mesh = Mesh(devs[:total].reshape(shape), tuple(names))
+    return mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def default_mesh() -> Mesh:
+    """Current mesh, or a 1-axis 'dp' mesh over all devices."""
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = create_mesh({"dp": -1})
+    return _current_mesh
+
+
+def mesh_axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_mesh()
+    if m is None or name not in m.axis_names:
+        return 1
+    return m.shape[name]
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    prev = get_mesh()
+    set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(prev)

@@ -1,0 +1,101 @@
+"""DataParallel wrapper + sharded train-step builder.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:321 (DataParallel →
+C++ Reducer bucketed allreduce, imperative/reducer.cc) and the compiled
+equivalent CompiledProgram.with_data_parallel.
+
+TPU-native: there is no Reducer — `dp_train_step` builds a jit'd step
+whose gradients carry a psum over the 'dp' mesh axis; XLA buckets and
+overlaps the allreduce with the backward automatically (the exact
+optimization Reducer::MarkVarReady hand-codes). The eager DataParallel
+wrapper exists for API parity: in a single-process world forward is
+unchanged, and `apply_collective_grads` is the explicit-sync escape
+hatch (no-op at world 1).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import env
+from .collective import all_reduce, ReduceOp
+from .mesh import Mesh, NamedSharding, PartitionSpec, default_mesh
+
+__all__ = ["DataParallel", "scale_loss", "dp_shard_batch", "param_shardings"]
+
+
+def scale_loss(loss):
+    """reference parallel.py scale_loss (divide by nranks before
+    backward so the summed allreduce averages)."""
+    n = env.get_world_size()
+    if n <= 1:
+        return loss
+    return loss / n
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity (reference fluid/dygraph/parallel.py:321).
+
+    find_unused_parameters / comm_buffer_size are accepted for API parity;
+    XLA's fused backward makes both moot (no per-bucket scheduling)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return scale_loss(loss)
+
+    def apply_collective_grads(self):
+        """Allreduce all parameter grads (reference Reducer's job)."""
+        if env.get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    # attribute passthrough for wrapped-layer access parity
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def param_shardings(params, mesh: Mesh):
+    """NamedShardings for a pytree of Parameters/arrays: use
+    param.pspec when a parallel layer marked one, replicate otherwise."""
+    def one(p):
+        spec = getattr(p, "pspec", None) or PartitionSpec()
+        return NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(
+        one, params, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def dp_shard_batch(batch, mesh: Optional[Mesh] = None, axis="dp"):
+    """Place a host batch sharded over the dp axis (the reference fed
+    per-device scopes; here one device_put with a NamedSharding)."""
+    m = mesh or default_mesh()
+    def put(x):
+        arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        spec = PartitionSpec(axis, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(m, spec))
+    return jax.tree_util.tree_map(
+        put, batch, is_leaf=lambda x: isinstance(x, Tensor))

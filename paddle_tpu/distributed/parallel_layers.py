@@ -1,0 +1,154 @@
+"""Tensor-model-parallel layers.
+
+Reference: python/paddle/distributed/collective.py:492-566
+(_parallel_linear / _parallel_embedding behind paddle.distributed.split):
+column-parallel Linear (shard out_features; allgather output),
+row-parallel Linear (shard in_features; allreduce output), vocab-sharded
+Embedding (shard_index + allreduce).
+
+TPU-native: the layers hold FULL logical weights annotated with a
+PartitionSpec over the 'tp'/'mp' mesh axis (weight.pspec); under
+pjit/shard_map GSPMD places the shards and inserts the
+allreduce/allgather exactly where the reference's explicit c_allreduce /
+c_allgather ops sat. Inside shard_map (manual mode) the forward uses the
+explicit lax collectives. Eagerly (world=1) they behave like plain
+layers, which matches the reference's nranks==1 fast path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from .mesh import PartitionSpec, get_mesh, mesh_axis_size
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "mark_sharding"]
+
+
+def mark_sharding(param, spec: PartitionSpec):
+    """Attach a PartitionSpec to a Parameter; compiled trainers read
+    param.pspec to build NamedShardings (the reference marks tensors
+    is_distributed for the same purpose, collective.py:520)."""
+    param.pspec = spec
+    param.is_distributed = any(s is not None for s in spec)
+    return param
+
+
+def _in_shard_map(axis_name) -> bool:
+    """True when tracing inside shard_map with axis_name bound."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+class ColumnParallelLinear(Layer):
+    """Y = X @ W with W sharded on columns (out_features). Output is
+    either gathered (gather_output=True, reference default in split) or
+    left sharded for a following RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, gather_output=True,
+                 axis_name="tp", name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.axis_name = axis_name
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, PartitionSpec(None, axis_name))
+        self.bias = None
+        if has_bias and bias_attr is not False:
+            self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                              is_bias=True)
+            mark_sharding(self.bias, PartitionSpec(axis_name))
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output and _in_shard_map(self.axis_name):
+            name = self.axis_name
+            y = apply(lambda a: jax.lax.all_gather(a, name, axis=a.ndim - 1,
+                                                   tiled=True),
+                      y, name="c_allgather")
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Y = X @ W with W sharded on rows (in_features); partial products
+    are summed with allreduce (reference _parallel_linear axis=0 path →
+    c_allreduce_sum on output)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, input_is_parallel=True,
+                 axis_name="tp", name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.axis_name = axis_name
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, PartitionSpec(axis_name, None))
+        self.bias = None
+        if has_bias and bias_attr is not False:
+            # bias added AFTER the reduce, replicated
+            self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                              is_bias=True)
+            mark_sharding(self.bias, PartitionSpec(None))
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, None)
+        if _in_shard_map(self.axis_name):
+            name = self.axis_name
+            y = apply(lambda a: jax.lax.psum(a, name), y,
+                      name="c_allreduce_sum")
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dimension sharded (reference
+    _parallel_embedding, collective.py:527: shard_index + lookup +
+    allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 axis_name="mp", name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.axis_name = axis_name
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        mark_sharding(self.weight, PartitionSpec(axis_name, None))
+
+    def forward(self, x):
+        if not _in_shard_map(self.axis_name):
+            return F.embedding(x, self.weight)
+        name = self.axis_name
+
+        def fn(ids, w):
+            # local shard covers rows [rank*per, (rank+1)*per)
+            per = w.shape[0]
+            rank = jax.lax.axis_index(name)
+            start = rank * per
+            local = ids.astype(jnp.int32) - start
+            in_range = (local >= 0) & (local < per)
+            safe = jnp.clip(local, 0, per - 1)
+            out = jnp.take(w, safe, axis=0)
+            out = jnp.where(in_range[..., None], out, 0.0)
+            return jax.lax.psum(out, name)
+
+        return apply(fn, x, self.weight, name="vocab_parallel_embedding")

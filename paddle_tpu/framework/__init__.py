@@ -1,0 +1,4 @@
+"""paddle.framework parity surface (dtype helpers, save/load, seed)."""
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from .io import save, load  # noqa: F401

@@ -1,0 +1,76 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:201,279 — pickle of (nested)
+state dicts with tensors replaced by ndarrays, plus protocol switches.
+The C++ fast path (_save_static_dict, pybind.cc:414) is unnecessary
+here: jax device_get batches the D2H transfer.
+
+Checkpointing large sharded arrays goes through
+paddle_tpu.distributed.checkpoint (orbax-style sharded save) — this
+module is the small-object path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_SENTINEL_KEY = "__paddle_tpu_tensor__"
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return {_SENTINEL_KEY: True,
+                "data": np.asarray(obj.data),
+                "name": obj.name,
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        packed = [_pack(v) for v in obj]
+        return t(packed) if t in (list, tuple) else packed
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL_KEY):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            if cls is Parameter:
+                t = Parameter(obj["data"], name=obj.get("name"))
+            else:
+                t = Tensor(obj["data"], name=obj.get("name"),
+                           stop_gradient=obj.get("stop_gradient", True))
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        un = [_unpack(v, return_numpy) for v in obj]
+        return t(un) if t in (list, tuple) else un
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save parity: state dicts, nested containers, single tensors."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load parity."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,21 @@
+"""paddle.io parity: Dataset / Sampler / DataLoader.
+
+Reference: python/paddle/fluid/dataloader/ (dataset.py, batch_sampler.py,
+dataloader_iter.py) + fluid/reader.py DataLoader (§2.6 of SURVEY.md) and
+the C++ double-buffered reader (operators/reader/buffered_reader.cc).
+
+TPU-native design: worker parallelism uses a thread pool feeding a
+bounded prefetch queue (the reference forked processes because CUDA +
+fork + Python made threads useless for CPU-bound decode; here the decode
+work releases the GIL in numpy and the XLA device transfer is async, so
+threads + double buffering deliver the same overlap without shared-memory
+mmap plumbing). The final host->device stage pins the next batch onto the
+accelerator while the current step runs — the buffered_reader.cc pattern.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle.nn parity surface (reference python/paddle/nn/__init__.py).
+
+Layer system over the eager tape / functional bridge; see layer_base.py.
+"""
+from .layer_base import Layer, ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
+
+functional_alias = functional

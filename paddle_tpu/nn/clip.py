@@ -1,0 +1,97 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm). Clippers operate
+on (param, grad) pairs like the reference's _dygraph_clip, and also expose
+a pure-array form (`clip_arrays`) for the compiled/pjit training path
+where grads are a pytree of jax.Arrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_by_global_norm_arrays"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def clip_arrays(self, grads):
+        """Pure functional form over a pytree of arrays (jit-safe)."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.data, self.min, self.max))))
+        return out
+
+    def clip_arrays(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g * scale).astype(g.dtype)
+
+    def _dygraph_clip(self, params_grads):
+        return [(p, g if g is None or not getattr(p, "need_clip", True)
+                 else Tensor(self._clip_one(g.data)))
+                for p, g in params_grads]
+
+    def clip_arrays(self, grads):
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+def clip_by_global_norm_arrays(grads, clip_norm):
+    """Global-norm clip over a pytree of arrays; returns (clipped, norm)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in leaves))
+    scale = jnp.minimum(clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+    return jax.tree_util.tree_map(
+        lambda g: (g * scale).astype(g.dtype), grads), gn
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        arrs = [g.data for p, g in params_grads
+                if g is not None and getattr(p, "need_clip", True)]
+        if not arrs:
+            return params_grads
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(a.astype(jnp.float32)))
+                          for a in arrs))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g.data * scale).astype(g.data.dtype))))
+        return out
+
+    def clip_arrays(self, grads):
+        clipped, _ = clip_by_global_norm_arrays(grads, self.clip_norm)
+        return clipped

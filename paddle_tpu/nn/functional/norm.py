@@ -1,0 +1,203 @@
+"""Normalization functionals.
+
+Reference parity: /root/reference/paddle/fluid/operators/batch_norm_op.cc,
+layer_norm_op.cc, instance_norm_op.cc, group_norm_op.cc, norm_op.cc and
+python/paddle/nn/functional/norm.py. Batch statistics are computed inline
+(one fused XLA reduction) — no cuDNN batch-norm descriptors. The
+distributed SyncBatchNorm variant lives in paddle_tpu.distributed (psum
+over the dp axis replaces the reference's sync_batch_norm_op.cu NCCL
+allreduce of statistics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "normalize", "local_response_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional batch norm. In training mode the running stats tensors
+    are UPDATED IN PLACE on the host side (matching the reference's
+    mean_out/variance_out aliasing, batch_norm_op.cc)."""
+    channel_last = not data_format.startswith("NC")
+    use_batch_stats = training and not use_global_stats
+
+    def stats_axes(a):
+        ch_axis = a.ndim - 1 if channel_last else min(1, a.ndim - 1)
+        return tuple(i for i in range(a.ndim) if i != ch_axis), ch_axis
+
+    if use_batch_stats:
+        # compute batch stats eagerly for the running update
+        xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        axes, ch_axis = stats_axes(xa)
+        bm = jnp.mean(xa.astype(jnp.float32), axis=axes)
+        bv = jnp.var(xa.astype(jnp.float32), axis=axes)
+        if isinstance(running_mean, Tensor):
+            running_mean._data = (momentum * running_mean.data +
+                                  (1 - momentum) * bm).astype(
+                                      running_mean.data.dtype)
+            running_var._data = (momentum * running_var.data +
+                                 (1 - momentum) * bv).astype(
+                                     running_var.data.dtype)
+        mean_in, var_in = bm, bv
+    else:
+        mean_in = running_mean
+        var_in = running_var
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, m, v, *rest):
+        axes, ch_axis = stats_axes(a)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        m = m.reshape(shape).astype(jnp.float32)
+        v = v.reshape(shape).astype(jnp.float32)
+        out = (a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+        it = iter(rest)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x, mean_in, var_in]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(fn, *args, name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, *rest):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - m) * jax.lax.rsqrt(v + epsilon)
+        it = iter(rest)
+        if has_w:
+            out = out * next(it)
+        if has_b:
+            out = out + next(it)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(fn, *args, name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, *rest):
+        if channel_last:
+            axes = tuple(range(1, a.ndim - 1))
+            ch_axis = a.ndim - 1
+        else:
+            axes = tuple(range(2, a.ndim))
+            ch_axis = 1
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        it = iter(rest)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(fn, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, *rest):
+        if channel_last:
+            a_nc = jnp.moveaxis(a, -1, 1)
+        else:
+            a_nc = a
+        n, c = a_nc.shape[:2]
+        spatial = a_nc.shape[2:]
+        g = a_nc.reshape(n, num_groups, c // num_groups, *spatial)
+        gf = g.astype(jnp.float32)
+        axes = tuple(range(2, gf.ndim))
+        m = jnp.mean(gf, axis=axes, keepdims=True)
+        v = jnp.var(gf, axis=axes, keepdims=True)
+        out = ((gf - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_nc.shape)
+        shape = [1, c] + [1] * len(spatial)
+        it = iter(rest)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        out = out.astype(a.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply(fn, *args, name="group_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        norm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+    return apply(fn, x, name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """reference lrn_op.cc."""
+    channel_last = not data_format.startswith("NC")
+
+    def fn(a):
+        ch_axis = a.ndim - 1 if channel_last else 1
+        sq = jnp.square(a.astype(jnp.float32))
+        sq = jnp.moveaxis(sq, ch_axis, -1)
+        pad = (size - 1) // 2
+        sq_p = jnp.pad(sq, [(0, 0)] * (sq.ndim - 1) +
+                       [(pad, size - 1 - pad)])
+        win = sum(sq_p[..., i:i + sq.shape[-1]] for i in range(size))
+        div = (k + alpha * win / size) ** beta
+        div = jnp.moveaxis(div, -1, ch_axis)
+        return (a / div).astype(a.dtype)
+
+    return apply(fn, x, name="local_response_norm")

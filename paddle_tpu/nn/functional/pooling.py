@@ -1,0 +1,206 @@
+"""Pooling functionals over lax.reduce_window.
+
+Reference parity: /root/reference/paddle/fluid/operators/pool_op.cc,
+pool_op.cu (cuDNN pooling) and python/paddle/nn/functional/pooling.py.
+lax.reduce_window is the direct XLA lowering; adaptive pooling computes
+per-bin windows statically (shapes are static under jit anyway).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else v * n))[:n]
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = []
+        for p in padding:
+            if isinstance(p, (list, tuple)):
+                flat.extend(int(x) for x in p)
+            else:
+                flat.append(int(p))
+        if len(flat) == n:
+            return [(p, p) for p in flat]
+        return [(flat[2 * i], flat[2 * i + 1]) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
+          ceil_mode=False, count_include_pad=True, divisor_override=None,
+          name="pool"):
+    channel_last = not data_format.startswith("NC")
+    k = _tuplize(kernel, n)
+    s = _tuplize(stride if stride is not None else kernel, n)
+    p = _pads(padding, n)
+
+    def fn(a):
+        if channel_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+        if isinstance(p, str):
+            padcfg = p
+        else:
+            sp = [(0, 0), (0, 0)] if not channel_last else [(0, 0)]
+            padcfg = sp + list(p) + ([] if not channel_last else [(0, 0)])
+            if ceil_mode:
+                # extend high padding so the last partial window is kept
+                spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+                padcfg = [list(q) for q in padcfg]
+                off = 2 if not channel_last else 1
+                for i in range(n):
+                    size = spatial[i] + padcfg[off + i][0] + padcfg[off + i][1]
+                    rem = (size - k[i]) % s[i]
+                    if rem != 0:
+                        padcfg[off + i][1] += s[i] - rem
+                padcfg = [tuple(q) for q in padcfg]
+        if reducer == "max":
+            out = jax.lax.reduce_window(
+                a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.iinfo(a.dtype).min,
+                jax.lax.max, dims, strides, padcfg)
+        else:
+            summed = jax.lax.reduce_window(
+                a, 0.0, jax.lax.add, dims, strides, padcfg)
+            if divisor_override:
+                out = summed / divisor_override
+            elif count_include_pad or isinstance(padcfg, str):
+                out = summed / np.prod(k)
+            else:
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, dims, strides, padcfg)
+                out = summed / counts
+        return out.astype(a.dtype)
+
+    return apply(fn, x, name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "max", None,
+                 ceil_mode=ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 None, ceil_mode=ceil_mode, name="max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 None, ceil_mode=ceil_mode, name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "avg", 0.0,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", 0.0,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 divisor_override=divisor_override, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", 0.0,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 divisor_override=divisor_override, name="avg_pool3d")
+
+
+def _adaptive(x, output_size, n, data_format, reducer, name):
+    channel_last = not data_format.startswith("NC")
+    out_sizes = output_size if isinstance(output_size, (list, tuple)) else \
+        (output_size,) * n
+    out_sizes = tuple(int(v) if v is not None else None for v in out_sizes)
+
+    def fn(a):
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        targets = tuple(o if o is not None else s
+                        for o, s in zip(out_sizes, spatial))
+        out = a
+        # Pool one spatial axis at a time: split into bins when divisible
+        # (the common case — one reshape+mean, XLA-friendly), else gather
+        # per-bin slices.
+        for i in range(n):
+            ax = (1 + i) if channel_last else (2 + i)
+            size = out.shape[ax]
+            tgt = targets[i]
+            if size % tgt == 0:
+                k = size // tgt
+                new_shape = out.shape[:ax] + (tgt, k) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = (jnp.max(r, axis=ax + 1) if reducer == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                starts = [(j * size) // tgt for j in range(tgt)]
+                ends = [-(-((j + 1) * size) // tgt) for j in range(tgt)]
+                pieces = []
+                for s0, e0 in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(s0, e0)
+                    piece = out[tuple(sl)]
+                    pieces.append(jnp.max(piece, axis=ax, keepdims=True)
+                                  if reducer == "max"
+                                  else jnp.mean(piece, axis=ax, keepdims=True))
+                out = jnp.concatenate(pieces, axis=ax)
+        return out.astype(a.dtype)
+
+    return apply(fn, x, name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "NCW", "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format, "avg",
+                     "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format, "avg",
+                     "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")

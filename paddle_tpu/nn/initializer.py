@@ -1,0 +1,215 @@
+"""Parameter initializers.
+
+TPU-native re-design of the reference initializer suite
+(/root/reference/python/paddle/fluid/initializer.py — ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormal, Xavier, MSRA
+(Kaiming), NumpyArrayInitializer). The reference appends fill ops into a
+startup Program executed once; here an Initializer is a callable
+`(shape, dtype, key) -> jax.Array` evaluated eagerly at Layer construction
+(there is no separate startup program — XLA has no use for one).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Bilinear", "calculate_gain", "set_global_initializer",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+class Initializer:
+    """Base initializer (reference fluid/initializer.py:Initializer)."""
+
+    def __call__(self, shape: Sequence[int], dtype=None, key=None):
+        raise NotImplementedError
+
+    def _key(self, key):
+        return key if key is not None else prandom.next_key()
+
+    @staticmethod
+    def _fans(shape):
+        """Receptive-field-aware fan computation (reference
+        initializer.py Initializer._compute_fans)."""
+        shape = tuple(shape)
+        if len(shape) == 0:
+            return 1, 1
+        if len(shape) == 1:
+            return shape[0], shape[0]
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        # conv kernels: [out_c, in_c, *spatial] (paddle layout)
+        receptive = int(np.prod(shape[2:]))
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        return jnp.full(tuple(shape), self.value, convert_dtype(dtype) or jnp.float32)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = convert_dtype(dtype) or jnp.float32
+        return self.mean + self.std * jax.random.normal(
+            self._key(key), tuple(shape), d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = convert_dtype(dtype) or jnp.float32
+        return self.mean + self.std * jax.random.truncated_normal(
+            self._key(key), -2.0, 2.0, tuple(shape), d)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = convert_dtype(dtype) or jnp.float32
+        return jax.random.uniform(self._key(key), tuple(shape), d,
+                                  self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, fo = self._fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype, key)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, fo = self._fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype, key)
+
+
+class KaimingNormal(Initializer):
+    """MSRA init (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, _ = self._fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return Normal(0.0, std)(shape, dtype, key)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, _ = self._fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype, key)
+
+
+class Assign(Initializer):
+    """Initialize from a given array/list (reference NumpyArrayInitializer)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        arr = np.asarray(
+            self.value.numpy() if hasattr(self.value, "numpy") else self.value)
+        d = convert_dtype(dtype)
+        out = jnp.asarray(arr, dtype=d)
+        if tuple(out.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {out.shape} != param shape {tuple(shape)}")
+        return out
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for transposed conv (reference
+    initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype=None, key=None):
+        shape = tuple(shape)
+        if len(shape) != 4 or shape[2] != shape[3]:
+            raise ValueError("Bilinear expects [C_out, C_in, K, K]")
+        k = shape[3]
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, dtype=np.float32)
+        rng = np.arange(k)
+        filt = (1 - np.abs(rng / f - c))
+        kern = filt[:, None] * filt[None, :]
+        for i in range(shape[0]):
+            w[i, i % shape[1]] = kern
+        return jnp.asarray(w, dtype=convert_dtype(dtype) or jnp.float32)
+
+
+def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
+    """paddle.nn.initializer.calculate_gain parity."""
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity == "leaky_relu":
+        slope = 0.01 if param in (None, 0.0) else float(param or 0.01)
+        if param == 0.0:
+            slope = 0.0
+        return math.sqrt(2.0 / (1 + slope ** 2))
+    if nonlinearity in recommended:
+        return recommended[nonlinearity]
+    raise ValueError(f"Unsupported nonlinearity: {nonlinearity}")
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer parity."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def get_global_initializer():
+    return _global_weight_init, _global_bias_init

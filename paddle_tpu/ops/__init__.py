@@ -1,0 +1,10 @@
+"""paddle_tpu.ops — custom Pallas TPU kernels.
+
+The reference's equivalent is the C++/CUDA operator library
+(/root/reference/paddle/fluid/operators/); here the op library is the XLA
+op set (paddle_tpu.tensor / nn.functional lowerings), and this package
+holds only the kernels XLA won't produce on its own — fused attention
+today, with room for fused optimizers / collectives-overlapped matmuls.
+"""
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_available, set_interpret_mode)

@@ -1,0 +1,170 @@
+"""Pallas TPU flash attention (blockwise online-softmax).
+
+The reference has no training-time fused attention (only the inference
+fused/multihead_matmul_op.cu); this kernel is the TPU-native upgrade: the
+[B,H,S,S] score matrix never leaves VMEM — each q-block streams k/v-blocks
+through the MXU with running max/denominator, so HBM traffic is O(S·D)
+instead of O(S²). Backward recomputes attention via the XLA composite
+(standard flash recompute strategy; a Pallas backward kernel can slot in
+behind the same custom_vjp later).
+
+Layout contract: q, k, v are [B, S, H, D] (paddle flash_attention layout);
+internally processed per (batch, head).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is optional on CPU-only hosts
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_INTERPRET = False  # set True in tests to run the kernel on CPU
+
+
+def set_interpret_mode(flag: bool):
+    global _INTERPRET
+    _INTERPRET = bool(flag)
+
+
+def flash_attention_available() -> bool:
+    if not _HAS_PLTPU:
+        return False
+    if _INTERPRET:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+               scale: float, q_offset_blocks: int):
+    """One (batch*head, q_block) program: online softmax over k blocks.
+
+    q_ref: [block_q, d]; k_ref/v_ref: [S, d] (whole sequence for this head
+    in VMEM); o_ref: [block_q, d].
+    """
+    block_q, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    qi = pl.program_id(1)
+
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_start = (qi + q_offset_blocks) * block_q
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        sblk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            sblk = jnp.where(rows >= cols, sblk, -1e30)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only k blocks that intersect the causal triangle for this q block
+        last = (q_start + block_q + block_k - 1) // block_k
+        n_iter = jnp.minimum(last, n_k)
+    else:
+        n_iter = n_k
+    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _fa_forward_bhsd(q, k, v, causal, block_q=256, block_k=256):
+    """q,k,v: [BH, S, D] -> out [BH, S, D]. Block sizes must divide S —
+    pick the largest power-of-two block ≤ requested that does."""
+    bh, s, d = q.shape
+    while s % block_q != 0:
+        block_q //= 2
+    while s % block_k != 0:
+        block_k //= 2
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, s // block_q)
+
+    kernel = functools.partial(_fa_kernel, block_k=block_k, causal=causal,
+                               scale=scale, q_offset_blocks=0)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=_INTERPRET,
+    )(q, k, v)
+
+
+def _composite(q, k, v, causal):
+    """XLA reference math on [B,S,H,D]."""
+    d = q.shape[-1]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal=False):
+    """q,k,v: [B, S, H, D]. Fused Pallas forward; recompute backward."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    supported = (s == sk and s % 128 == 0 and (d % 128 == 0 or d == 64))
+    if not supported or not flash_attention_available():
+        return _composite(q, k, v, causal)
+    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+    out = _fa_forward_bhsd(qf, kf, vf, causal)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+
+
+def _fa_fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _fa_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _composite(a, b, c, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

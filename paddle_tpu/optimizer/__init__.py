@@ -1,0 +1,6 @@
+"""paddle.optimizer parity (reference python/paddle/optimizer/)."""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb,
+    Lars)
+from . import lr  # noqa: F401

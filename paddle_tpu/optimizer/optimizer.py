@@ -1,0 +1,176 @@
+"""Optimizer base.
+
+TPU-native re-design of the reference optimizer stack
+(/root/reference/python/paddle/optimizer/optimizer.py and the C++ kernels
+under paddle/fluid/operators/optimizers/). The reference appends per-param
+update ops (sgd_op.cc, adam_op.cc, ...) into a program; here every
+optimizer defines ONE pure update rule
+
+    update(param, grad, state, lr) -> (new_param, new_state)
+
+used two ways:
+- eagerly by `step()` (dygraph parity: accumulators live on the optimizer
+  keyed by param name, like the reference's `param@accumulator` Scope vars)
+- functionally by compiled trainers: `init_state(params)` +
+  `apply_gradients(params, grads, state, lr)` over pytrees of jax.Arrays,
+  which is what jit/pjit train steps call (state sharding specs follow
+  param sharding — that is ZeRO-friendly by construction).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from ..regularizer import L1Decay, L2Decay
+
+
+class Optimizer:
+    _accum_names: Sequence[str] = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        from . import lr as lr_mod
+
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._weight_decay = L2Decay(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+        # state: param name -> dict of accumulator arrays
+        self._accumulators: Dict[str, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+        self._lr_scheduler = self._lr if isinstance(
+            self._lr, lr_mod.LRScheduler) else None
+
+    # ---- learning rate ----------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError(
+                "cannot set_lr when using an LRScheduler; call "
+                "scheduler.step() instead")
+        self._lr = float(value)
+
+    # ---- update rule (override) ------------------------------------------
+    def _init_accumulators(self, param: jax.Array) -> Dict[str, jax.Array]:
+        return {name: jnp.zeros_like(param) for name in self._accum_names}
+
+    def _update(self, p: jax.Array, g: jax.Array, state: Dict[str, jax.Array],
+                lr, step) -> tuple:
+        raise NotImplementedError
+
+    # ---- decoupled weight decay hook (AdamW/Lamb override) ---------------
+    _decoupled_wd = 0.0
+
+    def _apply_decay(self, p, g, param_obj=None):
+        """Coupled (L1/L2-into-grad) regularization, reference
+        regularizer.py appended decay ops. Per-param regularizer overrides
+        the optimizer-level one."""
+        reg = getattr(param_obj, "regularizer", None) or self._weight_decay
+        if reg is None or self._decoupled_wd:
+            return g
+        return reg.apply(p, g)
+
+    # ---- eager path -------------------------------------------------------
+    def step(self):
+        if self._parameters is None:
+            raise InvalidArgumentError(
+                "Optimizer constructed without parameters; pass "
+                "parameters=model.parameters() for dygraph use.")
+        lr = self.get_lr()
+        for p in self._parameters:
+            if p.grad is None or not p.trainable:
+                continue
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            garr = g.data if isinstance(g, Tensor) else g
+            garr = self._apply_decay(p.data, garr, p)
+            key = p.name
+            if key not in self._accumulators:
+                self._accumulators[key] = self._init_accumulators(p.data)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            new_p, new_state = self._update(
+                p.data, garr, self._accumulators[key], plr,
+                self._step_count + 1)
+            p._data = new_p.astype(p.data.dtype)
+            self._accumulators[key] = new_state
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameters or [])]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameters or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ---- functional path (compiled trainers) ------------------------------
+    def init_state(self, params):
+        """params: pytree of jax.Array -> state pytree (same structure of
+        dicts). Used by jit/pjit train steps; state inherits param sharding."""
+        return jax.tree_util.tree_map(self._init_accumulators, params)
+
+    def apply_gradients(self, params, grads, state, lr=None, step=None):
+        """Pure update over pytrees. Returns (new_params, new_state)."""
+        lr = self.get_lr() if lr is None else lr
+        step = (self._step_count + 1) if step is None else step
+        if self._grad_clip is not None:
+            grads = self._grad_clip.clip_arrays(grads)
+        if self._weight_decay is not None and not self._decoupled_wd:
+            grads = jax.tree_util.tree_map(
+                lambda p, g: self._weight_decay.apply(p, g), params, grads)
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_s = treedef.flatten_up_to(state)
+        new_p, new_s = [], []
+        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
+            np_, ns_ = self._update(p, g, s, lr, step)
+            new_p.append(np_.astype(p.dtype))
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+    # ---- state dict -------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for pname, accs in self._accumulators.items():
+            for aname, arr in accs.items():
+                sd[f"{pname}@{aname}"] = Tensor(arr)
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if self._lr_scheduler is not None and "LR_Scheduler" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("LR_Scheduler", "@step") or "@" not in key:
+                continue
+            pname, aname = key.rsplit("@", 1)
+            arr = val.data if isinstance(val, Tensor) else jnp.asarray(val)
+            self._accumulators.setdefault(pname, {})[aname] = arr
+
+    @property
+    def _learning_rate(self):
+        return self._lr

@@ -1,0 +1,36 @@
+"""Weight-decay regularizers (reference python/paddle/fluid/regularizer.py:
+L1DecayRegularizer / L2DecayRegularizer — there they append decay ops onto
+the gradient; here `apply(param, grad)` returns the decayed gradient
+array, fused by XLA into the optimizer update)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def apply(self, p, g):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def apply(self, p, g):
+        return g + self._coeff * jnp.sign(p)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def apply(self, p, g):
+        return g + self._coeff * p
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"

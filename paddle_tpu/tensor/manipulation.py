@@ -322,8 +322,9 @@ def slice(input, axes, starts, ends, name=None):
         out = a
         for ax, s, e in zip(axes, starts, ends):
             dim = out.shape[ax]
-            s2 = s + dim if s < 0 else min(s, dim)
-            e2 = e + dim if e < 0 else min(e, dim)
+            # clamp into [0, dim] like the reference slice op
+            s2 = max(0, min(s + dim if s < 0 else s, dim))
+            e2 = max(s2, min(e + dim if e < 0 else e, dim))
             out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
         return out
 

@@ -114,7 +114,6 @@ def bernoulli_(x, p=0.5, name=None):
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
     x = x if isinstance(x, Tensor) else to_tensor(x)
-    logits = jnp.log(jnp.clip(x.data, 1e-30, None))
     if x.data.ndim == 1:
         out = jax.random.choice(next_key(), x.data.shape[0], (num_samples,),
                                 replace=replacement, p=x.data / x.data.sum())

@@ -33,9 +33,18 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
     def _as(a):
-        idx = jnp.argsort(a, axis=axis, stable=stable or descending)
-        if descending:
-            idx = jnp.flip(idx, axis=axis)
+        if descending and stable:
+            # stable descending: flipping a stable ascending sort reverses
+            # tie order; sort the flipped array instead and remap indices
+            # (exact for every dtype, unlike negating the keys).
+            n = a.shape[axis]
+            idx_rev = jnp.argsort(jnp.flip(a, axis=axis), axis=axis,
+                                  stable=True)
+            idx = n - 1 - jnp.flip(idx_rev, axis=axis)
+        else:
+            idx = jnp.argsort(a, axis=axis, stable=stable or descending)
+            if descending:
+                idx = jnp.flip(idx, axis=axis)
         return idx.astype(jnp.int64)
     return apply(_as, x, name="argsort")
 

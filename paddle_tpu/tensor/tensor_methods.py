@@ -31,6 +31,10 @@ def _bind():
             fn = getattr(mod, name)
             if not callable(fn) or isinstance(fn, type):
                 continue
+            # only the module's own ops — not helpers it imported
+            # (apply, convert_dtype, next_key, ...)
+            if getattr(fn, "__module__", None) != mod.__name__:
+                continue
             if not hasattr(Tensor, name):
                 setattr(Tensor, name, fn)
     Tensor.einsum = None  # not a method

@@ -9,7 +9,6 @@ Must set env BEFORE jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,9 +16,22 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# Force the host backend even when an accelerator plugin (axon TPU tunnel)
+# was registered at interpreter start: env vars are too late by then, the
+# config flag is not. 8 virtual CPU devices exercise the multi-chip
+# sharding paths (SURVEY.md §4's "multi-node without a cluster" strategy).
+jax.config.update("jax_platforms", "cpu")
+
 # Numeric-grad checks need exact fp32 matmuls (the backend's default
 # precision is bf16-pass based, fine for training, too loose for OpTest).
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent compile cache: the suite is dominated by XLA compiles of tiny
+# graphs; cache them across pytest processes (same trick as the reference's
+# ccache-heavy CI).
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,501 @@
+"""nn.Layer system + layers tests (reference test strategy: unittests
+test_layers.py, test_conv2d_op.py, test_batch_norm_op.py ... — here
+numeric checks are against numpy/torch-free references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr, dtype=np.float32),
+                            stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        sd = net.state_dict()
+        assert set(sd) == set(names)
+
+        net2 = Net()
+        net2.set_state_dict(sd)
+        for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                      net2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(3)
+        assert "_mean" in bn.state_dict()
+        assert len(bn.buffers()) == 2
+
+    def test_train_eval(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_hooks(self):
+        lin = nn.Linear(3, 3)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(t(np.ones((2, 3))))
+        assert calls == [1]
+        h.remove()
+        lin(t(np.ones((2, 3))))
+        assert calls == [1]
+
+    def test_apply_and_sublayers(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(net.sublayers()) == 3
+        seen = []
+        net.apply(lambda l: seen.append(type(l).__name__))
+        assert "Sequential" in seen and "Linear" in seen
+
+
+class TestCommonLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(4, 3)
+        x = np.random.randn(5, 4).astype(np.float32)
+        got = lin(t(x)).numpy()
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[0, 3], [5, 0]], dtype=np.int32))
+        out = emb(ids).numpy()
+        assert np.all(out[0, 0] == 0) and np.all(out[1, 1] == 0)
+        assert not np.all(out[0, 1] == 0)
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(6, 3)
+        ids = paddle.to_tensor(np.array([1, 1, 2], dtype=np.int32))
+        out = emb(ids)
+        out.sum().backward()
+        g = emb.weight.grad.numpy()
+        assert g[1].sum() == pytest.approx(6.0)  # row 1 hit twice
+        assert g[0].sum() == 0
+
+    def test_dropout_modes(self):
+        x = t(np.ones((100, 100)))
+        d = nn.Dropout(0.5)
+        y = d(x).numpy()
+        # upscale_in_train: surviving values are 2.0
+        vals = np.unique(y)
+        assert set(np.round(vals, 5)).issubset({0.0, 2.0})
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_flatten(self):
+        x = t(np.zeros((2, 3, 4, 5)))
+        assert nn.Flatten()(x).shape == [2, 60]
+        assert nn.Flatten(0, 1)(x).shape == [6, 4, 5]
+
+    def test_pad2d(self):
+        x = t(np.ones((1, 1, 2, 2)))
+        y = F.pad(x, [1, 1, 0, 0])  # left/right
+        assert y.shape == [1, 1, 2, 4]
+
+    def test_upsample(self):
+        x = t(np.arange(4).reshape(1, 1, 2, 2))
+        y = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert y.shape == [1, 1, 4, 4]
+
+
+class TestConv:
+    def test_conv2d_identity_kernel(self):
+        conv = nn.Conv2D(1, 1, 3, padding=1,
+                         weight_attr=nn.initializer.Constant(0.0),
+                         bias_attr=False)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0
+        conv.weight.set_value(w)
+        x = np.random.randn(2, 1, 5, 5).astype(np.float32)
+        np.testing.assert_allclose(conv(t(x)).numpy(), x, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(2, 3, 2, stride=2, bias_attr=False)
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        got = conv(t(x)).numpy()
+        w = conv.weight.numpy()
+        want = np.zeros((1, 3, 2, 2), np.float32)
+        for o in range(3):
+            for i_ in range(2):
+                for r in range(2):
+                    for c in range(2):
+                        want[0, o, r, c] += np.sum(
+                            x[0, i_, r * 2:r * 2 + 2, c * 2:c * 2 + 2] *
+                            w[o, i_])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=2)
+        x = t(np.random.randn(1, 4, 6, 6))
+        assert conv(x).shape == [1, 4, 6, 6]
+
+    def test_conv2d_transpose_shape(self):
+        convt = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        x = t(np.random.randn(2, 3, 8, 8))
+        assert convt(x).shape == [2, 6, 16, 16]
+
+    def test_conv_transpose_inverts_stride(self):
+        # transpose of all-ones kernel, stride 2: each input pixel spreads
+        convt = nn.Conv2DTranspose(1, 1, 2, stride=2, bias_attr=False)
+        convt.weight.set_value(np.ones((1, 1, 2, 2), np.float32))
+        x = np.array([[[[1.0, 2.0], [3.0, 4.0]]]], np.float32)
+        got = convt(t(x)).numpy()
+        want = np.array([[[[1, 1, 2, 2], [1, 1, 2, 2],
+                           [3, 3, 4, 4], [3, 3, 4, 4]]]], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_conv1d(self):
+        conv = nn.Conv1D(2, 4, 3, padding=1)
+        x = t(np.random.randn(2, 2, 10))
+        assert conv(x).shape == [2, 4, 10]
+
+
+class TestNorm:
+    def test_batchnorm_train_normalizes(self):
+        bn = nn.BatchNorm1D(8, data_format="NC")
+        x = np.random.randn(64, 8).astype(np.float32) * 5 + 3
+        y = bn(t(x)).numpy()
+        np.testing.assert_allclose(y.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(y.std(0), 1, atol=1e-2)
+
+    def test_batchnorm_updates_running_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.0)  # momentum 0 -> running=batch
+        x = np.random.randn(4, 3, 5, 5).astype(np.float32) + 7
+        bn(t(x))
+        np.testing.assert_allclose(bn._mean.numpy(),
+                                   x.mean(axis=(0, 2, 3)), rtol=1e-3)
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(2)
+        bn.eval()
+        x = np.random.randn(3, 2, 4, 4).astype(np.float32)
+        y = bn(t(x)).numpy()
+        np.testing.assert_allclose(y, x, rtol=1e-3, atol=1e-3)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(16)
+        x = np.random.randn(4, 6, 16).astype(np.float32) * 3 + 1
+        y = ln(t(x)).numpy()
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-4)
+        np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+        y = gn(t(x)).numpy()
+        grp = y.reshape(2, 2, 2 * 5 * 5)
+        np.testing.assert_allclose(grp.mean(-1), 0, atol=1e-4)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = np.random.randn(3, 8).astype(np.float32)
+        y = rn(t(x)).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+class TestPooling:
+    def test_max_pool2d(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = F.max_pool2d(t(x), 2).numpy()
+        np.testing.assert_array_equal(y[0, 0], [[5, 7], [13, 15]])
+
+    def test_avg_pool2d(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = F.avg_pool2d(t(x), 2).numpy()
+        np.testing.assert_allclose(y[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_avg_pool2d(self):
+        x = t(np.random.randn(2, 3, 8, 8))
+        y = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(y.numpy()[..., 0, 0],
+                                   x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+    def test_adaptive_nondivisible(self):
+        x = t(np.random.randn(1, 2, 7, 7))
+        assert F.adaptive_avg_pool2d(x, 3).shape == [1, 2, 3, 3]
+
+
+class TestLosses:
+    def test_cross_entropy_matches_numpy(self):
+        logits = np.random.randn(6, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, (6,))
+        got = float(F.cross_entropy(t(logits),
+                                    paddle.to_tensor(labels)))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(6), labels]).mean()
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        got = float(F.cross_entropy(t(logits), paddle.to_tensor(labels),
+                                    ignore_index=-100))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 2]]).mean()
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_soft_label(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        got = float(F.cross_entropy(t(logits), t(soft), soft_label=True))
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        want = (-(soft * logp).sum(-1)).mean()
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_mse_and_l1(self):
+        a, b = np.random.randn(5), np.random.randn(5)
+        assert float(F.mse_loss(t(a), t(b))) == pytest.approx(
+            ((a - b) ** 2).mean(), rel=1e-5)
+        assert float(F.l1_loss(t(a), t(b))) == pytest.approx(
+            np.abs(a - b).mean(), rel=1e-5)
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(8).astype(np.float32)
+        y = np.random.randint(0, 2, 8).astype(np.float32)
+        got = float(F.binary_cross_entropy_with_logits(t(z), t(y)))
+        p = 1 / (1 + np.exp(-z))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_kl_smooth_nll(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 3)).astype(np.float32)
+        tgt = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        got = float(F.kl_div(t(logp), t(tgt), reduction="sum"))
+        want = (tgt * (np.log(tgt) - logp)).sum()
+        assert got == pytest.approx(want, rel=1e-3)
+
+    def test_ctc_loss_simple(self):
+        # single batch, trivially checkable: T=2, labels=[a]
+        logp = np.log(np.full((2, 1, 3), 1 / 3, np.float32))
+        labels = np.array([[1]], np.int32)
+        got = F.ctc_loss(t(logp), paddle.to_tensor(labels),
+                         paddle.to_tensor(np.array([2])),
+                         paddle.to_tensor(np.array([1])),
+                         reduction="none").numpy()[0]
+        # paths: (blank,a),(a,blank),(a,a) = 3 paths * (1/9)
+        want = -np.log(3 / 9)
+        assert got == pytest.approx(want, rel=1e-4)
+
+
+class TestActivationsGrad:
+    @pytest.mark.parametrize("fn,npfn", [
+        (F.relu, lambda a: np.maximum(a, 0)),
+        (F.sigmoid, lambda a: 1 / (1 + np.exp(-a))),
+        (F.tanh, np.tanh),
+        (F.softplus, lambda a: np.log1p(np.exp(a))),
+        (F.silu, lambda a: a / (1 + np.exp(-a))),
+    ])
+    def test_forward(self, fn, npfn):
+        x = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(fn(t(x)).numpy(), npfn(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_grad_check(self):
+        import math
+        from op_test import check_grad
+        x = np.random.randn(3, 4)
+        check_grad(F.gelu, lambda a: 0.5 * a * (
+            1 + np.vectorize(math.erf)(a / np.sqrt(2))), [x])
+
+    def test_softmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        y = F.softmax(t(x)).numpy()
+        np.testing.assert_allclose(y.sum(-1), 1, rtol=1e-5)
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = t(np.random.randn(3, 6, 4))
+        y, (h, c) = lstm(x)
+        assert y.shape == [3, 6, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+    def test_gru_matches_cell(self):
+        gru = nn.GRU(3, 5)
+        x = np.random.randn(2, 4, 3).astype(np.float32)
+        y, h = gru(t(x))
+        # final hidden equals last output
+        np.testing.assert_allclose(h.numpy()[0], y.numpy()[:, -1],
+                                   rtol=1e-5)
+
+    def test_lstmcell_step(self):
+        cell = nn.LSTMCell(4, 6)
+        x = t(np.random.randn(2, 4))
+        out, (h, c) = cell(x)
+        assert out.shape == [2, 6]
+        np.testing.assert_allclose(out.numpy(), h.numpy())
+
+    def test_bidirect_concat(self):
+        rnn = nn.SimpleRNN(4, 6, direction="bidirectional")
+        x = t(np.random.randn(2, 5, 4))
+        y, h = rnn(x)
+        assert y.shape == [2, 5, 12]
+
+
+class TestTransformer:
+    def test_encoder_layer(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        layer.eval()
+        x = t(np.random.randn(2, 6, 16))
+        assert layer(x).shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        m = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32,
+                           dropout=0.0)
+        m.eval()
+        src = t(np.random.randn(2, 5, 16))
+        tgt = t(np.random.randn(2, 3, 16))
+        assert m(src, tgt).shape == [2, 3, 16]
+
+    def test_mha_cache_incremental_decode(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = t(np.random.randn(1, 4, 8))
+        full = mha(x, x, x,
+                   attn_mask=paddle.to_tensor(
+                       np.tril(np.ones((4, 4), bool))))
+        cache = mha.gen_cache(t(np.zeros((1, 0, 8))))
+        outs = []
+        for i in range(4):
+            step = x[:, i:i + 1]
+            o, cache = mha(step, step, step, None, cache)
+            outs.append(o.numpy())
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(inc, full.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_sdpa_causal_matches_mask(self):
+        q = np.random.randn(1, 5, 2, 4).astype(np.float32)
+        got = F.scaled_dot_product_attention(t(q), t(q), t(q),
+                                             is_causal=True).numpy()
+        mask = np.tril(np.ones((5, 5), bool))
+        got2 = F.scaled_dot_product_attention(
+            t(q), t(q), t(q),
+            attn_mask=paddle.to_tensor(mask)).numpy()
+        np.testing.assert_allclose(got, got2, rtol=1e-4, atol=1e-5)
+
+
+class TestFlashAttentionKernel:
+    def test_pallas_matches_composite(self):
+        from paddle_tpu.ops import flash_attention as fa
+        import jax.numpy as jnp
+        fa_mod = __import__("paddle_tpu.ops.flash_attention",
+                            fromlist=["*"])
+        q = jnp.asarray(np.random.randn(1, 128, 2, 64), jnp.float32)
+        k = jnp.asarray(np.random.randn(1, 128, 2, 64), jnp.float32)
+        v = jnp.asarray(np.random.randn(1, 128, 2, 64), jnp.float32)
+        ref = fa_mod._composite(q, k, v, True)
+        fa_mod.set_interpret_mode(True)
+        try:
+            got = fa_mod.flash_attention(q, k, v, True)
+        finally:
+            fa_mod.set_interpret_mode(False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-3)
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings (round 1)."""
+
+    def test_inplace_relu_grad(self):
+        x = t(np.array([-2.0, 3.0]), sg=False)
+        y = x * 1.0
+        F.relu_(y)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0])
+
+    def test_batch_norm_bias_without_weight(self):
+        import paddle_tpu.nn.functional as F_
+        x = t(np.random.randn(8, 3))
+        mean = t(np.zeros(3))
+        var = t(np.ones(3))
+        bias = t(np.full(3, 7.0))
+        y = F_.batch_norm(x, mean, var, weight=None, bias=bias)
+        np.testing.assert_allclose(y.numpy(), x.numpy() + 7.0, rtol=1e-4)
+
+    def test_layer_norm_bias_without_weight(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        y = F.layer_norm(t(x), 8, weight=None, bias=t(np.full(8, 2.0)))
+        assert y.numpy().mean() == pytest.approx(2.0, abs=1e-4)
+
+    def test_lstm_initial_state_used(self):
+        lstm = nn.LSTM(4, 6)
+        x = t(np.random.randn(2, 5, 4))
+        h0 = t(np.full((1, 2, 6), 0.5))
+        c0 = t(np.full((1, 2, 6), 0.5))
+        y1, _ = lstm(x)
+        y2, _ = lstm(x, (h0, c0))
+        assert not np.allclose(y1.numpy(), y2.numpy())
+
+    def test_rnn_interlayer_dropout_active(self):
+        rnn = nn.SimpleRNN(4, 8, num_layers=2, dropout=0.9)
+        rnn.train()
+        x = t(np.random.randn(2, 5, 4))
+        y1, _ = rnn(x)
+        y2, _ = rnn(x)
+        assert not np.allclose(y1.numpy(), y2.numpy())
+        rnn.eval()
+        y3, _ = rnn(x)
+        y4, _ = rnn(x)
+        np.testing.assert_allclose(y3.numpy(), y4.numpy())
+
+    def test_align_corners_bilinear(self):
+        # align_corners: corners map exactly
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        y = F.interpolate(t(x), size=[3, 3], mode="bilinear",
+                          align_corners=True).numpy()[0, 0]
+        np.testing.assert_allclose(
+            y, [[0, 0.5, 1], [1, 1.5, 2], [2, 2.5, 3]], rtol=1e-5)
+
+    def test_flash_attention_nonpow2_blocks(self):
+        import importlib
+        fa_mod = importlib.import_module("paddle_tpu.ops.flash_attention")
+        import jax.numpy as jnp
+        q = jnp.asarray(np.random.randn(1, 384, 1, 64), jnp.float32)
+        ref = fa_mod._composite(q, q, q, True)
+        fa_mod.set_interpret_mode(True)
+        try:
+            got = fa_mod.flash_attention(q, q, q, True)
+        finally:
+            fa_mod.set_interpret_mode(False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-3)
+
+    def test_spectral_norm_persists_uv(self):
+        sn = nn.SpectralNorm([4, 3], power_iters=1)
+        w = t(np.random.randn(4, 3))
+        u_before = sn.weight_u.numpy().copy()
+        sn(w)
+        u_after1 = sn.weight_u.numpy().copy()
+        sn(w)
+        u_after2 = sn.weight_u.numpy().copy()
+        assert not np.allclose(u_before, u_after1)
+        # converging: consecutive iterates get closer
+        assert np.linalg.norm(u_after2 - u_after1) < \
+            np.linalg.norm(u_after1 - u_before) + 1e-3

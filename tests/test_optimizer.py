@@ -1,0 +1,220 @@
+"""Optimizer + LR scheduler tests (reference unittests test_sgd_op.py,
+test_adam_op.py, test_lr_scheduler.py — numeric update-rule checks vs
+hand-rolled numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def make_param(arr):
+    p = paddle.Parameter(np.asarray(arr, np.float32))
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.need_clip = True
+    return p
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+class TestUpdateRules:
+    def test_sgd(self):
+        p = make_param([1.0, 2.0])
+        set_grad(p, [0.5, 0.5])
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.95, 1.95], rtol=1e-6)
+
+    def test_momentum(self):
+        p = make_param([1.0])
+        o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0])
+        o.step()  # v=1, p=1-0.1
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        set_grad(p, [1.0])
+        o.step()  # v=1.9, p=0.9-0.19
+        np.testing.assert_allclose(p.numpy(), [0.71], rtol=1e-6)
+
+    def test_adam_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(4).astype(np.float32)
+        p = make_param(w)
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        m = np.zeros(4)
+        v = np.zeros(4)
+        cur = w.astype(np.float64)
+        for step in range(1, 4):
+            g = rng.randn(4).astype(np.float32)
+            set_grad(p, g)
+            o.step()
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            lr_t = 0.01 * np.sqrt(1 - 0.999 ** step) / (1 - 0.9 ** step)
+            cur = cur - lr_t * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), cur, rtol=1e-5, atol=1e-6)
+
+    def test_adamw_decoupled_decay(self):
+        p1 = make_param([1.0])
+        o1 = opt.Adam(learning_rate=0.1, parameters=[p1])
+        p2 = make_param([1.0])
+        o2 = opt.AdamW(learning_rate=0.1, weight_decay=0.1, parameters=[p2])
+        set_grad(p1, [0.0])
+        set_grad(p2, [0.0])
+        o1.step()
+        o2.step()
+        # zero grad: Adam leaves param, AdamW decays it by lr*wd*p
+        np.testing.assert_allclose(p1.numpy(), [1.0], atol=1e-6)
+        np.testing.assert_allclose(p2.numpy(), [1.0 - 0.1 * 0.1 * 1.0],
+                                   rtol=1e-5)
+
+    def test_lamb_trust_ratio(self):
+        p = make_param(np.full(3, 2.0))
+        o = opt.Lamb(learning_rate=0.1, lamb_weight_decay=0.0,
+                     parameters=[p])
+        set_grad(p, np.full(3, 1.0))
+        o.step()
+        # m1h=1, m2h=1 -> r=1/ (1+eps) ~1; trust = |p|/|r| = 2
+        expect = 2.0 - 0.1 * 2.0 * (1.0 / (1.0 + 1e-6))
+        np.testing.assert_allclose(p.numpy(), np.full(3, expect), rtol=1e-4)
+
+    def test_weight_decay_l2(self):
+        p = make_param([1.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p],
+                    weight_decay=paddle.regularizer.L2Decay(0.5))
+        set_grad(p, [0.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        p1 = make_param([3.0])
+        p2 = make_param([4.0])
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        o = opt.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+        set_grad(p1, [3.0])
+        set_grad(p2, [4.0])
+        o.step()  # global norm 5 -> scale 0.2
+        np.testing.assert_allclose(p1.numpy(), [3.0 - 0.6], rtol=1e-5)
+        np.testing.assert_allclose(p2.numpy(), [4.0 - 0.8], rtol=1e-5)
+
+
+class TestFunctionalPath:
+    def test_apply_gradients_matches_step(self):
+        import jax.numpy as jnp
+        w = np.random.randn(3, 2).astype(np.float32)
+        g = np.random.randn(3, 2).astype(np.float32)
+        # eager
+        p = make_param(w)
+        o1 = opt.Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, g)
+        o1.step()
+        # functional
+        o2 = opt.Adam(learning_rate=0.01)
+        params = {"w": jnp.asarray(w)}
+        state = o2.init_state(params)
+        new_params, _ = o2.apply_gradients(params, {"w": jnp.asarray(g)},
+                                           state, lr=0.01, step=1)
+        np.testing.assert_allclose(p.numpy(), np.asarray(new_params["w"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        p = make_param([1.0, 2.0])
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, [0.1, 0.1])
+        o.step()
+        sd = o.state_dict()
+        p2 = make_param([1.0, 2.0])
+        p2.name = p.name
+        o2 = opt.Adam(learning_rate=0.01, parameters=[p2])
+        o2.set_state_dict(sd)
+        assert o2._step_count == 1
+        np.testing.assert_allclose(
+            o2._accumulators[p.name]["moment1"],
+            o._accumulators[p.name]["moment1"])
+
+
+class TestTraining:
+    def test_linear_regression_converges(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 3).astype(np.float32)
+        true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+        Y = X @ true_w
+        lin = nn.Linear(3, 1)
+        o = opt.Adam(learning_rate=0.1, parameters=lin.parameters())
+        for _ in range(150):
+            pred = lin(paddle.to_tensor(X))
+            loss = nn.functional.mse_loss(pred, paddle.to_tensor(Y))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        np.testing.assert_allclose(lin.weight.numpy(), true_w, atol=0.05)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(1.0, step_size=2, gamma=0.5)
+        lrs = [s()]
+        for _ in range(4):
+            s.step()
+            lrs.append(s())
+        np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-8)
+        s.step(5)
+        assert s() == pytest.approx(0.5, abs=1e-8)
+
+    def test_linear_warmup(self):
+        s = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        s.step(5)
+        assert s() == pytest.approx(0.05)
+        s.step(15)
+        assert s() == pytest.approx(0.1)
+
+    def test_noam(self):
+        s = opt.lr.NoamDecay(d_model=512, warmup_steps=4000)
+        s.step(4000)
+        peak = s()
+        s.step(100)
+        assert s() < peak
+        s.step(8000)
+        assert s() < peak
+
+    def test_piecewise(self):
+        s = opt.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        vals = []
+        for e in [0, 2, 3, 5, 6, 10]:
+            s.step(e)
+            vals.append(s())
+        np.testing.assert_allclose(
+            vals, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001])
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)  # 2 bad epochs > patience -> reduce
+        assert s() == pytest.approx(0.5)
+
+    def test_scheduler_with_optimizer(self):
+        p = make_param([1.0])
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=sched, parameters=[p])
+        assert o.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert o.get_lr() == pytest.approx(0.01)
+
+    def test_one_cycle(self):
+        s = opt.lr.OneCycleLR(max_learning_rate=1.0, total_steps=100)
+        s.step(30)
+        assert s() == pytest.approx(1.0, abs=1e-6)
+        s.step(100)
+        assert s() == pytest.approx(0.0001, abs=1e-3)

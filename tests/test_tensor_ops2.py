@@ -77,3 +77,47 @@ class TestFixedOps:
         np.testing.assert_array_equal(out.numpy(), [1, -1, -1, 3])
         out = paddle.shard_index(x, index_num=10, nshards=2, shard_id=1)
         np.testing.assert_array_equal(out.numpy(), [-1, 0, 4, -1])
+
+
+class TestReviewRegressionsRound1b:
+    def test_single_element_tuple_backward(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32),
+                             stop_gradient=False)
+        y = paddle.split(x, 1)[0]
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(4))
+
+    def test_bool_flag_string_false(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": "false"})
+        assert paddle.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"] is False
+        paddle.set_flags({"FLAGS_check_nan_inf": "true"})
+        assert paddle.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"] is True
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_slice_clamps(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        y = paddle.slice(x, axes=[1], starts=[-10], ends=[2])
+        np.testing.assert_allclose(y.numpy(), x.numpy()[:, 0:2])
+
+    def test_stable_descending_argsort(self):
+        import paddle_tpu as paddle
+        idx = paddle.argsort(
+            paddle.to_tensor(np.array([1.0, 1.0, 2.0], np.float32)),
+            descending=True, stable=True)
+        np.testing.assert_array_equal(idx.numpy(), [2, 0, 1])
+
+    def test_no_helper_pollution(self):
+        from paddle_tpu.core.tensor import Tensor
+        for bad in ("apply", "convert_dtype", "next_key",
+                    "default_float_dtype"):
+            assert not hasattr(Tensor, bad), bad
+
+    def test_place_hashable(self):
+        import paddle_tpu as paddle
+        d = {paddle.CPUPlace(): 1, paddle.TPUPlace(0): 2}
+        assert d[paddle.CPUPlace()] == 1
