@@ -1,0 +1,15 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast.py:20, grad_scaler.py:20), C++
+autocast imperative/amp_auto_cast.cc, kernels operators/amp/
+{check_finite_and_unscale_op,update_loss_scaling_op}.
+
+TPU-native notes: bf16 is the native mixed-precision dtype (MXU computes
+bf16 x bf16 -> fp32) and needs NO loss scaling; fp16 + dynamic loss
+scaling is kept for API/semantic parity. The per-op white/black list
+casting hooks into core.autograd.apply via the thread-local amp state —
+the same interception point as the reference's Tracer AutoCastInputs.
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, amp_state, white_list, black_list, decorate)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

@@ -1,0 +1,114 @@
+"""auto_cast context (reference paddle/amp/auto_cast.py:20 +
+fluid/dygraph/amp/auto_cast.py:65-73 white/black lists +
+imperative/amp_auto_cast.cc AutoCastInputs)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+__all__ = ["auto_cast", "amp_guard", "amp_state", "white_list", "black_list",
+           "decorate"]
+
+# reference fluid/dygraph/amp/auto_cast.py:65 WHITE_LIST / BLACK_LIST,
+# extended with this framework's op names.
+WHITE_LIST: Set[str] = {
+    "conv2d", "conv1d", "conv3d", "conv2d_transpose", "matmul", "matmul_v2",
+    "mul", "linear", "einsum", "bmm", "flash_attention",
+    "scaled_dot_product_attention", "lstm", "gru", "rnn_tanh", "rnn_relu",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2", "log_softmax", "binary_cross_entropy",
+    "bce_with_logits", "nll_loss", "kl_div", "layer_norm", "batch_norm",
+    "group_norm", "instance_norm", "rms_norm", "reduce_mean", "reduce_sum",
+    "mse_loss", "l1_loss", "smooth_l1_loss", "ctc_loss", "cumsum",
+    "softplus", "erf", "pow", "norm",
+}
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "white", "black")
+
+    def __init__(self, enabled, dtype, level, white, black):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def amp_state() -> Optional[_AmpState]:
+    return getattr(_tls, "amp", None)
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity. level O1 = per-op lists; O2 = cast
+    everything float except the black list (pure fp16/bf16)."""
+    d = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") else jnp.float16
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    prev = amp_state()
+    _tls.amp = _AmpState(bool(enable), d, level, white, black)
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_inputs_for_op(name: str, arrs):
+    """Called from core.autograd.apply: cast float arrays per the active
+    amp policy (the AutoCastInputs hook, amp_auto_cast.cc)."""
+    st = amp_state()
+    if st is None or not st.enabled or not name:
+        return arrs
+
+    def is_float(a):
+        return hasattr(a, "dtype") and \
+            jnp.issubdtype(a.dtype, jnp.floating)
+
+    if name in st.black:
+        return tuple(a.astype(jnp.float32) if is_float(a) and
+                     a.dtype != jnp.float32 else a for a in arrs)
+    if name in st.white or st.level == "O2":
+        return tuple(a.astype(st.dtype) if is_float(a) and
+                     a.dtype == jnp.float32 else a for a in arrs)
+    return arrs
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the amp dtype
+    (master weights live in the optimizer's fp32 accumulators)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,123 @@
+"""GradScaler: dynamic loss scaling.
+
+Reference: python/paddle/amp/grad_scaler.py:20 over
+fluid/dygraph/amp/loss_scaler.py:27 (AmpScaler) and the C++ state machine
+operators/amp/update_loss_scaling_op.cc: scale up by incr_ratio after
+incr_every_n_steps finite steps, scale down by decr_ratio after
+decr_every_n_nan_or_inf bad steps, skip the update on nan/inf.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, loss):
+        """Multiply the loss (reference AmpScaler.scale)."""
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        """Divide grads by the scale and detect non-finite values
+        (reference check_finite_and_unscale_op)."""
+        if not self._enable or self._unscaled:
+            return
+        found = False
+        for p in optimizer._parameters or []:
+            if p.grad is None:
+                continue
+            g = p.grad.data / self._scale
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad._data = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def minimize(self, optimizer, loss, *args, **kwargs):
+        loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        """Unscale, then step unless non-finite grads were found
+        (reference GradScaler.step + update_loss_scaling skip logic)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        """Dynamic scale adjustment (update_loss_scaling_op.cc state
+        machine)."""
+        if not self._enable or not self._use_dynamic:
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._good_steps = 0
+            self._bad_steps += 1
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._bad_steps = 0
+            self._good_steps += 1
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "use_dynamic_loss_scaling": self._use_dynamic}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+class GradScaler(AmpScaler):
+    """paddle.amp.GradScaler parity (grad_scaler.py:20)."""
+    pass

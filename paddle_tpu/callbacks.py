@@ -1,0 +1,5 @@
+"""paddle.callbacks parity — re-export of hapi callbacks (reference
+python/paddle/callbacks pointing at hapi/callbacks.py)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, History, LRScheduler, ModelCheckpoint,
+    ProgBarLogger)

@@ -126,6 +126,14 @@ def apply(fn, *args, name: str = ""):
     from .tensor import Tensor
 
     arrs = tuple(a.data if isinstance(a, Tensor) else a for a in args)
+
+    # AMP autocast hook (reference Tracer::TraceOp -> AutoCastInputs,
+    # imperative/amp_auto_cast.cc). Import is deferred and state checked
+    # cheaply so the non-AMP path pays one attribute lookup.
+    from ..amp.auto_cast import amp_state, cast_inputs_for_op
+    if amp_state() is not None:
+        arrs = cast_inputs_for_op(name, arrs)
+
     needs_grad = _grad_enabled() and any(
         isinstance(a, Tensor) and not a.stop_gradient for a in args
     )
@@ -207,6 +215,11 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
             c = slots.get(i)
             if c is None:
                 c = jax.numpy.zeros(shape, dtype)
+            elif dtype is not None and getattr(c, "dtype", None) != dtype:
+                # mixed-precision boundary (AMP): downstream ops may have
+                # produced cotangents in their compute dtype; vjp demands
+                # the recorded output dtype
+                c = c.astype(dtype)
             cots.append(c)
         if node.vjp_fn is None:
             raise PreconditionNotMetError(

@@ -135,6 +135,13 @@ class Tensor:
         self._retain_grads = True
 
     def _accumulate_grad(self, g):
+        # leaf grads live in the leaf's dtype (AMP: ops may run bf16 but a
+        # fp32 master param accumulates fp32 grads, like the reference's
+        # cast-op backward restoring the source dtype)
+        if hasattr(g, "dtype") and g.dtype != self._data.dtype and \
+                jnp.issubdtype(g.dtype, jnp.floating) and \
+                jnp.issubdtype(self._data.dtype, jnp.floating):
+            g = g.astype(self._data.dtype)
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True, name=self.name + "@GRAD")
         else:

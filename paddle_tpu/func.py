@@ -1,0 +1,91 @@
+"""Functional bridge: Layer -> pure function over a param pytree.
+
+This is the TPU-native replacement for the reference's dygraph-to-static
+ProgramTranslator (fluid/dygraph/dygraph_to_static/program_translator.py:756)
+— instead of AST-rewriting Python into a ProgramDesc, we TRACE the layer's
+forward with its parameters swapped for function arguments, which jax.jit /
+jax.grad / shard_map then compile. 15 AST transformer passes collapse into
+~60 lines because XLA traces Python directly.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Tuple
+
+import jax
+
+from .core.tensor import Tensor
+from .nn.layer_base import Layer
+
+__all__ = ["functional_state", "functional_call", "functional_forward"]
+
+
+def functional_state(layer: Layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Split a layer into (params, buffers) dicts of raw jax.Arrays keyed
+    by structured name."""
+    params = {name: p.data for name, p in layer.named_parameters()}
+    buffers = {name: b.data for name, b in layer.named_buffers()
+               if b is not None}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped(layer: Layer, params: Dict[str, Any], buffers: Dict[str, Any]):
+    """Temporarily bind arrays (possibly tracers) into the layer's
+    parameter/buffer tensors; restore originals on exit."""
+    originals = {}
+    tensors = dict(layer.named_parameters())
+    buf_tensors = dict(layer.named_buffers())
+    for name, arr in params.items():
+        t = tensors[name]
+        originals[id(t)] = (t, t._data)
+        t._data = arr
+    for name, arr in (buffers or {}).items():
+        t = buf_tensors.get(name)
+        if t is None:
+            continue
+        originals[id(t)] = (t, t._data)
+        t._data = arr
+    try:
+        yield buf_tensors
+    finally:
+        for t, data in originals.values():
+            t._data = data
+
+
+def functional_call(layer: Layer, params: Dict[str, Any],
+                    buffers: Dict[str, Any], *args, training=None, **kwargs):
+    """Run layer.forward with `params`/`buffers` bound, returning
+    (outputs, new_buffers). Outputs keep their Tensor wrappers unwrapped
+    to raw arrays so the result is a clean pytree for jit.
+
+    new_buffers captures in-place buffer mutations (BatchNorm running
+    stats) — the functional analogue of the reference's mean_out/var_out
+    aliased outputs (batch_norm_op.cc).
+    """
+    prev_mode = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    try:
+        with _swapped(layer, params, buffers) as buf_tensors:
+            wrapped_args = [Tensor(a) if not isinstance(a, Tensor) else a
+                            for a in args]
+            out = layer(*wrapped_args, **kwargs)
+            new_buffers = {name: t.data for name, t in buf_tensors.items()
+                           if t is not None and name in (buffers or {})}
+        return _unwrap(out), new_buffers
+    finally:
+        if training is not None:
+            layer.train() if prev_mode else layer.eval()
+
+
+def functional_forward(layer: Layer, params, *args, **kwargs):
+    """Convenience: functional_call without buffer plumbing."""
+    out, _ = functional_call(layer, params, {}, *args, **kwargs)
+    return out
+
+
+def _unwrap(out):
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))

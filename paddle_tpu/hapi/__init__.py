@@ -1,0 +1,4 @@
+"""hapi: the Keras-like high-level API (reference python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .model_summary import summary, flops  # noqa: F401

@@ -1,0 +1,276 @@
+"""Model: prepare/fit/evaluate/predict/save/load.
+
+Reference: python/paddle/hapi/model.py (Model:810 prepare, :1244 fit,
+:1299 evaluate, :1515 predict; DynamicGraphAdapter:609). The static-graph
+adapter is unnecessary — one eager loop covers both because to_static /
+XLA compilation happens inside the layer when the user wants it.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric.metrics import Metric
+from ..nn.layer_base import Layer
+from . import callbacks as cbks_mod
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # ---- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+
+    # ---- single-batch ops (reference Model.train_batch/eval_batch) -------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[self._t(i) for i in inputs])
+        losses = self._compute_loss(outputs, labels)
+        losses.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(losses)], metrics) if metrics else [float(losses)]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with no_grad():
+            outputs = self.network(*[self._t(i) for i in inputs])
+            losses = self._compute_loss(outputs, labels) \
+                if self._loss is not None else None
+        metrics = self._update_metrics(outputs, labels)
+        loss_list = [float(losses)] if losses is not None else []
+        return (loss_list, metrics) if metrics else loss_list
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+        self.network.eval()
+        inputs = _to_list(inputs)
+        with no_grad():
+            outputs = self.network(*[self._t(i) for i in inputs])
+        return _to_list(outputs)
+
+    def _t(self, x):
+        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = [self._t(l) for l in labels]
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        return self._loss(*(outs + labs))
+
+    def _update_metrics(self, outputs, labels):
+        res = {}
+        outs = _to_list(outputs)
+        labs = [self._t(l) for l in labels]
+        for m in self._metrics:
+            pre = m.compute(*(outs + labs))
+            m.update(*_to_list(pre))
+            res[m.name()[0] if isinstance(m.name(), list) else m.name()] = \
+                m.accumulate()
+        return res
+
+    # ---- loops ------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """reference hapi/model.py:1244."""
+        train_loader = self._as_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs,
+            steps=self._try_len(train_loader), log_freq=log_freq,
+            save_freq=save_freq, save_dir=save_dir, verbose=verbose,
+            metrics=self._metrics_names())
+        cbks.on_begin("train")
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       accumulate_grad_batches, num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          callbacks=None,
+                                          _inner_cbks=cbks)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        if save_dir is not None:
+            self.save(os.path.join(save_dir, "final"))
+        cbks.on_end("train")
+
+    def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None):
+        logs = {}
+        for m in self._metrics:
+            m.reset()
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_batch_begin(mode, step, logs)
+            ins, labs = self._split_batch(batch)
+            update = (step + 1) % accum == 0
+            if mode == "train":
+                out = self.train_batch(ins, labs, update=update)
+            else:
+                out = self.eval_batch(ins, labs)
+            if isinstance(out, tuple):
+                loss_list, metrics = out
+            else:
+                loss_list, metrics = out, {}
+            if loss_list:
+                logs["loss"] = loss_list[0]
+            logs.update(metrics)
+            logs["batch_size"] = (labs[0].shape[0] if labs else
+                                  ins[0].shape[0])
+            cbks.on_batch_end(mode, step, logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None,
+                 _inner_cbks=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbks = _inner_cbks or cbks_mod.config_callbacks(
+            callbacks, model=self, steps=self._try_len(loader),
+            log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics_names())
+        if _inner_cbks is None:
+            cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval",
+                                   num_iters=num_iters)
+        if _inner_cbks is None:
+            cbks.on_end("eval", logs)
+        out = {}
+        if "loss" in logs:
+            out["loss"] = logs["loss"]
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            out.update(dict(zip(names, vals)))
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append([o.numpy() if isinstance(o, Tensor) else o
+                            for o in outs])
+        # transpose to per-output lists
+        grouped = list(zip(*outputs))
+        if stack_outputs:
+            return [np.concatenate(g, axis=0) for g in grouped]
+        return [list(g) for g in grouped]
+
+    # ---- persistence (reference hapi/model.py:1043 save) ------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # ---- helpers ----------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _try_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _n_inputs(self):
+        """Positional-arg count of network.forward (reference uses the
+        _inputs spec for the same decision)."""
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+            return len([p for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty])
+        except (TypeError, ValueError):
+            return 1
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if len(batch) > 1:
+                n_in = self._n_inputs()
+                if has_labels:
+                    n_in = min(n_in, len(batch) - 1)
+                return batch[:n_in], (batch[n_in:] if has_labels else [])
+            return batch, []
+        return [batch], []
+
+    def _metrics_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names

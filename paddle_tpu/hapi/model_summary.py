@@ -1,0 +1,119 @@
+"""paddle.summary / paddle.flops (reference python/paddle/hapi/
+model_summary.py, dynamic_flops.py)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer output shapes + parameter counts via forward hooks
+    (reference model_summary.py:summary)."""
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = builtins_sum(
+                int(np.prod(p.shape)) for p in l._parameters.values()
+                if p is not None)
+            rows.append((name or l.__class__.__name__,
+                         l.__class__.__name__, shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    import builtins
+    builtins_sum = builtins.sum
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaves only
+            register(sub, name)
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        x = [Tensor(np.zeros([1 if s is None or s == -1 else s
+                              for s in size], np.float32))
+             for size in sizes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        net.train() if was_training else net.eval()
+        for h in hooks:
+            h.remove()
+
+    total = builtins_sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = builtins_sum(int(np.prod(p.shape)) for p in net.parameters()
+                             if p.trainable)
+    width = 72
+    print("-" * width)
+    print(f"{'Layer (type)':<32}{'Output Shape':<24}{'Param #':<12}")
+    print("=" * width)
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<32}{str(shape):<24}{n:<12}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+_FLOP_RULES = {}
+
+
+def _conv_flops(layer, inp, out):
+    k = int(np.prod(layer._kernel_size))
+    cin = layer._in_channels // layer._groups
+    return int(np.prod(out.shape)) * cin * k * 2
+
+
+def _linear_flops(layer, inp, out):
+    return 2 * int(np.prod(inp.shape)) * layer._out_features
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Forward-pass FLOPs estimate (reference dynamic_flops.py)."""
+    total = [0]
+    hooks = []
+
+    def register(layer):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            inp = inputs[0]
+            cls = l.__class__.__name__
+            if custom_ops and type(l) in custom_ops:
+                total[0] += custom_ops[type(l)](l, inp, out)
+            elif cls.startswith("Conv"):
+                total[0] += _conv_flops(l, inp, out)
+            elif cls == "Linear":
+                total[0] += _linear_flops(l, inp, out)
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for _, sub in net.named_sublayers():
+        if not sub._sub_layers:
+            register(sub)
+    x = Tensor(np.zeros([1 if s is None or s == -1 else s
+                         for s in input_size], np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        net.train() if was_training else net.eval()
+        for h in hooks:
+            h.remove()
+    return total[0]

@@ -1,0 +1,16 @@
+"""paddle.jit parity: to_static / save / load.
+
+Reference: fluid/dygraph/dygraph_to_static/ (ProgramTranslator:756,
+StaticFunction/@to_static:233, PartialProgramLayer) + paddle.jit.save/load
+via TranslatedLayer.
+
+TPU-native: AST transformation is unnecessary — jax traces the Python
+directly (paddle_tpu.func.functional_call) and XLA compiles the whole
+step. `save` exports the compiled function as serialized StableHLO
+(jax.export) + a pickled state dict; `load` returns a TranslatedLayer
+that calls the deserialized executable — the analogue of
+save_inference_model + AnalysisPredictor for the common path.
+"""
+from .api import (  # noqa: F401
+    to_static, not_to_static, StaticFunction, save, load, TranslatedLayer,
+    in_tracing, enable_to_static)

@@ -1,0 +1,268 @@
+"""to_static: compile a Layer or function with XLA.
+
+Reference mapping:
+- @to_static / StaticFunction (program_translator.py:233): here a wrapper
+  that traces forward through paddle_tpu.func.functional_call and caches
+  one jax.jit executable per (input shapes/dtypes, training flag).
+- PartialProgramLayer (runs the static block inside dygraph, with grads):
+  here the jitted pure function participates in the eager tape via
+  core.autograd.apply over (params, buffers, inputs) — backward gets the
+  XLA-compiled VJP, so train loops keep working unchanged.
+- RNG: dropout keys become traced arguments (core.random.rng_guard), so
+  randomness stays fresh across compiled steps instead of baking in.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.autograd import apply as tape_apply
+from ..core.tensor import Parameter, Tensor
+from ..func import functional_state
+from ..nn.layer_base import Layer
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load",
+           "TranslatedLayer", "in_tracing", "enable_to_static"]
+
+_tls = threading.local()
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    """ProgramTranslator().enable(False) parity."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def in_tracing() -> bool:
+    return bool(getattr(_tls, "tracing", 0))
+
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda a: (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape")
+        else a, tree)
+
+
+class StaticFunction:
+    """Callable wrapping a Layer (or plain function) with compile cache
+    (reference StaticFunction + its ProgramCache)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 property=False):
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._layer = getattr(function, "__self__", None)
+            self._fn = function
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, self._fn)
+
+    # -- pure fn construction ---------------------------------------------
+    def _make_pure(self, training: bool):
+        layer = self._layer
+        fn = self._fn
+
+        def pure(params, buffers, key, args):
+            _tls.tracing = getattr(_tls, "tracing", 0) + 1
+            try:
+                with prandom.rng_guard(key):
+                    if layer is not None:
+                        from ..func import functional_call
+                        out, new_buf = functional_call(
+                            layer, params, buffers, *args, training=training)
+                    else:
+                        wrapped = jax.tree_util.tree_map(Tensor, args)
+                        out = fn(*wrapped)
+                        out = jax.tree_util.tree_map(
+                            lambda t: t.data if isinstance(t, Tensor) else t,
+                            out, is_leaf=lambda t: isinstance(t, Tensor))
+                        new_buf = {}
+                return out, new_buf
+            finally:
+                _tls.tracing -= 1
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs) if self._layer is None else \
+                self._layer(*args, **kwargs)
+        layer = self._layer
+        training = layer.training if layer is not None else False
+        arg_arrays = tuple(
+            a.data if isinstance(a, Tensor) else jnp.asarray(a)
+            for a in args)
+        if layer is not None:
+            params, buffers = functional_state(layer)
+        else:
+            params, buffers = {}, {}
+        cache_key = (training, _abstract(arg_arrays))
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            pure = self._make_pure(training)
+            jitted = jax.jit(pure)
+            entry = self._cache[cache_key] = jitted
+        jitted = entry
+
+        key = prandom.next_key()
+        param_names = list(params)
+        buf_names = list(buffers)
+
+        # participate in the eager tape: params are differentiable leaves
+        def tape_fn(*flat):
+            p = dict(zip(param_names, flat[:len(param_names)]))
+            b = dict(zip(buf_names,
+                         flat[len(param_names):len(param_names) +
+                              len(buf_names)]))
+            in_args = flat[len(param_names) + len(buf_names):]
+            out, new_buf = jitted(p, b, key, tuple(in_args))
+            flat_out, treedef = jax.tree_util.tree_flatten(out)
+            self._last_treedef = treedef
+            self._n_out = len(flat_out)
+            return tuple(flat_out) + tuple(new_buf[n] for n in buf_names
+                                           if n in new_buf)
+
+        param_tensors = [p for _, p in layer.named_parameters()] \
+            if layer is not None else []
+        buffer_tensors = [b for _, b in layer.named_buffers()
+                          if b is not None] if layer is not None else []
+        flat_in = [*param_tensors, *buffer_tensors,
+                   *[a if isinstance(a, Tensor) else Tensor(a)
+                     for a in args]]
+        result = tape_apply(tape_fn, *flat_in, name="to_static")
+        result = result if isinstance(result, tuple) else (result,)
+        n_out = self._n_out
+        outs = result[:n_out]
+        new_bufs = result[n_out:]
+        # write back mutated buffers (BatchNorm stats) eagerly
+        live_buf = [b for _, b in layer.named_buffers()
+                    if b is not None] if layer is not None else []
+        for t, nb in zip(live_buf, new_bufs):
+            t._data = nb.data
+        out_tree = jax.tree_util.tree_unflatten(self._last_treedef, outs)
+        return out_tree
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self, *args):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity."""
+    def decorate(fn):
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+# --------------------------------------------------------------------------
+# save / load: StableHLO export for inference + state dict
+# --------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: exports (a) state dict and (b) a serialized
+    compiled inference function (StableHLO via jax.export) — the analogue
+    of save_inference_model's Program + params (fluid/io.py:1199)."""
+    from jax import export as jexport
+
+    if isinstance(layer, StaticFunction):
+        layer = layer._layer
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer or its to_static wrapper")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(shape, dtype), ...] or "
+            "example tensors to trace the export")
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    params, buffers = functional_state(layer)
+    was_training = layer.training
+    layer.eval()
+    try:
+        def infer_fn(params, buffers, *args):
+            from ..func import functional_call
+            with prandom.rng_guard(jax.random.key(0)):
+                out, _ = functional_call(layer, params, buffers, *args,
+                                         training=False)
+            return out
+
+        shaped = []
+        for spec in input_spec:
+            if isinstance(spec, Tensor):
+                shaped.append(
+                    jax.ShapeDtypeStruct(tuple(spec.data.shape),
+                                         spec.data.dtype))
+            elif hasattr(spec, "shape"):
+                shape = tuple(1 if s is None or s == -1 else int(s)
+                              for s in spec.shape)
+                dtype = getattr(spec, "dtype", None) or jnp.float32
+                from ..core.dtype import convert_dtype
+                shaped.append(jax.ShapeDtypeStruct(
+                    shape, convert_dtype(dtype) or jnp.float32))
+            else:
+                shaped.append(spec)
+
+        exported = jexport.export(jax.jit(infer_fn))(
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+            *shaped)
+        blob = exported.serialize()
+    finally:
+        layer.train() if was_training else layer.eval()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    state = {"params": {k: np.asarray(v) for k, v in params.items()},
+             "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """Inference layer over a deserialized export (reference
+    TranslatedLayer from jit.load)."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._buffers_arr = {k: jnp.asarray(v) for k, v in buffers.items()}
+
+    def forward(self, *args):
+        arrs = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args)
+        out = self._exported.call(self._params, self._buffers_arr, *arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def load(path, **configs):
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(exported, state["params"], state["buffers"])

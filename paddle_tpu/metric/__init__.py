@@ -1,0 +1,4 @@
+"""paddle.metric parity (reference python/paddle/metric/metrics.py:
+Metric base + Accuracy/Precision/Recall/Auc; C++ kernels
+operators/metrics/{accuracy_op,auc_op}.*)."""
+from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy  # noqa: F401

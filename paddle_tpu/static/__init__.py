@@ -1,0 +1,47 @@
+"""paddle.static facade (reference python/paddle/static/).
+
+There is no separate static-graph engine — XLA compiles traced programs
+(paddle_tpu.jit). This module keeps the parity surface: InputSpec for
+export signatures and thin aliases for the most-used static helpers.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["InputSpec", "data"]
+
+
+class InputSpec:
+    """reference python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.data.shape), tensor.data.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity -> an InputSpec (graph inputs are just
+    traced function arguments here)."""
+    return InputSpec(shape, dtype, name)

@@ -1,0 +1,8 @@
+"""paddle.vision parity (reference python/paddle/vision/)."""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1, MobileNetV2,
+    mobilenet_v1, mobilenet_v2)

@@ -1,0 +1,182 @@
+"""AMP + jit.to_static tests (reference unittests test_amp_*.py,
+dygraph_to_static/ suite)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.static import InputSpec
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestAutoCast:
+    def test_white_op_casts(self):
+        lin = nn.Linear(4, 4)
+        x = t(np.random.randn(2, 4))
+        with amp.auto_cast():
+            y = lin(x)
+        assert str(y.dtype) == "bfloat16"
+        y2 = lin(x)
+        assert str(y2.dtype) == "float32"
+
+    def test_black_op_stays_fp32(self):
+        x = t(np.random.randn(2, 4))
+        with amp.auto_cast():
+            h = F.relu(x)  # not in either list: passthrough fp32
+            s = F.softmax(h)
+        assert str(s.dtype) == "float32"
+
+    def test_custom_lists(self):
+        x = t(np.random.randn(2, 4))
+        with amp.auto_cast(custom_black_list={"matmul"}):
+            y = paddle.matmul(x, x.T)
+        assert str(y.dtype) == "float32"
+
+    def test_o2_casts_everything(self):
+        x = t(np.random.randn(2, 4))
+        with amp.auto_cast(level="O2"):
+            y = x + 1.0
+        assert str(y.dtype) == "bfloat16"
+
+    def test_fp16_dtype(self):
+        lin = nn.Linear(4, 4)
+        x = t(np.random.randn(2, 4))
+        with amp.auto_cast(dtype="float16"):
+            y = lin(x)
+        assert str(y.dtype) == "float16"
+
+    def test_grads_flow_through_amp(self):
+        lin = nn.Linear(4, 1)
+        x = t(np.random.randn(8, 4))
+        with amp.auto_cast():
+            loss = lin(x).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert str(lin.weight.grad.dtype) == "float32"  # param grad fp32
+
+
+class TestGradScaler:
+    def test_scale_and_unscale(self):
+        p = paddle.Parameter(np.ones(2, np.float32))
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        o = opt.SGD(0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=4.0)
+        x = t([1.0, 2.0])
+        loss = (paddle.multiply(p, x)).sum()
+        scaler.scale(loss).backward()
+        # raw grad is scaled by 4
+        np.testing.assert_allclose(p.grad.numpy(), [4.0, 8.0])
+        scaler.step(o)
+        scaler.update()
+        # after unscale, sgd applied true grad [1,2]
+        np.testing.assert_allclose(p.numpy(), [1 - 0.1, 1 - 0.2],
+                                   rtol=1e-6)
+
+    def test_inf_skips_step_and_decays_scale(self):
+        p = paddle.Parameter(np.ones(1, np.float32))
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        o = opt.SGD(0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=1024,
+                                decr_every_n_nan_or_inf=1)
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(o)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+        assert scaler.get_loss_scaling() == 512.0
+
+    def test_dynamic_growth(self):
+        scaler = amp.GradScaler(init_loss_scaling=8.0,
+                                incr_every_n_steps=2)
+        p = paddle.Parameter(np.ones(1, np.float32))
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        o = opt.SGD(0.0, parameters=[p])
+        for _ in range(2):
+            p.grad = paddle.to_tensor(np.array([8.0], np.float32))
+            scaler.step(o)
+            scaler.update()
+        assert scaler.get_loss_scaling() == 16.0
+
+
+class TestToStatic:
+    def test_matches_eager_and_trains(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        paddle.seed(1)
+        net = Net()
+        static_net = paddle.jit.to_static(net)
+        x = t(np.random.randn(4, 4))
+        net.eval()
+        np.testing.assert_allclose(static_net(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # gradients flow through compiled call
+        net.train()
+        o = opt.SGD(0.5, parameters=net.parameters())
+        y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        losses = []
+        for _ in range(20):
+            loss = F.cross_entropy(static_net(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_buffer_writeback(self):
+        bn_net = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4, momentum=0.0,
+                                                               data_format="NC"))
+        static = paddle.jit.to_static(bn_net)
+        x = t(np.random.randn(16, 4) * 3 + 5)
+        static(x)
+        # running stats updated through the compiled call
+        assert abs(float(bn_net[1]._mean.numpy().mean())) > 0.01
+
+    def test_dropout_fresh_randomness(self):
+        net = nn.Sequential(nn.Dropout(0.5))
+        static = paddle.jit.to_static(net)
+        net.train()
+        x = t(np.ones((100,)))
+        y1 = static(x).numpy()
+        y2 = static(x).numpy()
+        assert not np.allclose(y1, y2)
+
+    def test_plain_function(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a = t(np.random.randn(3, 4))
+        b = t(np.random.randn(4, 2))
+        np.testing.assert_allclose(
+            f(a, b).numpy(), np.asarray(a.numpy() @ b.numpy() + 1.0),
+            rtol=1e-5)
+
+
+class TestJitSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(3)
+        net = LeNet()
+        net.eval()
+        x = t(np.random.randn(2, 1, 28, 28))
+        ref = net(x).numpy()
+        path = str(tmp_path / "export" / "model")
+        paddle.jit.save(net, path, input_spec=[InputSpec([2, 1, 28, 28])])
+        loaded = paddle.jit.load(path)
+        got = loaded(x).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,176 @@
+"""BASELINE config 1: LeNet/MNIST-shape end-to-end (reference test
+strategy: tests/book + hapi tests in python/paddle/tests/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.metric as metric
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.vision as vision
+from paddle_tpu.vision.datasets import FakeData
+
+
+@pytest.fixture(scope="module")
+def data():
+    train = FakeData(size=256, image_shape=(1, 28, 28), num_classes=10)
+    test = FakeData(size=64, image_shape=(1, 28, 28), num_classes=10,
+                    seed=1)
+    return train, test
+
+
+class TestLeNetE2E:
+    def test_fit_evaluate_predict_save_load(self, data, tmp_path):
+        train, test = data
+        paddle.seed(42)
+        lenet = vision.LeNet()
+        model = paddle.Model(lenet)
+        model.prepare(
+            opt.Adam(learning_rate=1e-3, parameters=lenet.parameters()),
+            nn.CrossEntropyLoss(), metric.Accuracy())
+        model.fit(train, epochs=4, batch_size=64, verbose=0)
+        res = model.evaluate(test, batch_size=64, verbose=0)
+        assert res["acc"] > 0.8, res
+
+        preds = model.predict(test, batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (64, 10)
+
+        path = str(tmp_path / "ck" / "best")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        lenet2 = vision.LeNet()
+        model2 = paddle.Model(lenet2)
+        model2.prepare(
+            opt.Adam(learning_rate=1e-3, parameters=lenet2.parameters()),
+            nn.CrossEntropyLoss(), metric.Accuracy())
+        model2.load(path)
+        res2 = model2.evaluate(test, batch_size=64, verbose=0)
+        assert abs(res2["acc"] - res["acc"]) < 1e-6
+
+    def test_early_stopping_and_history(self, data):
+        train, _ = data
+        paddle.seed(0)
+        lenet = vision.LeNet()
+        model = paddle.Model(lenet)
+        model.prepare(
+            opt.Adam(learning_rate=1e-3, parameters=lenet.parameters()),
+            nn.CrossEntropyLoss())
+        hist = paddle.callbacks.History()
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                            mode="min")
+        model.fit(train, epochs=3, batch_size=64, verbose=0,
+                  callbacks=[hist, es])
+        assert "loss" in hist.history and len(hist.history["loss"]) >= 1
+
+    def test_summary_and_flops(self):
+        lenet = vision.LeNet()
+        info = paddle.summary(lenet, (1, 1, 28, 28))
+        assert info["total_params"] == 61610
+        fl = paddle.flops(lenet, (1, 1, 28, 28))
+        assert fl > 0
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("ctor,ch,sz,n", [
+        (lambda: vision.resnet18(num_classes=7), 3, 32, 7),
+        (lambda: vision.mobilenet_v2(num_classes=5), 3, 32, 5),
+    ])
+    def test_forward_shapes(self, ctor, ch, sz, n):
+        m = ctor()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.randn(2, ch, sz, sz).astype(np.float32))
+        assert m(x).shape == [2, n]
+
+    def test_resnet50_param_count(self):
+        m = vision.resnet50(num_classes=1000)
+        total = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert total == 25_557_032  # torchvision/paddle resnet50 count
+
+    def test_vgg_structure(self):
+        m = vision.vgg11(num_classes=10)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.randn(1, 3, 224, 224).astype(np.float32))
+        assert m(x).shape == [1, 10]
+
+    def test_train_resnet_step(self):
+        m = vision.resnet18(num_classes=4)
+        o = opt.Momentum(0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        before = m.conv1.weight.numpy().copy()
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        o.step()
+        assert not np.allclose(before, m.conv1.weight.numpy())
+
+
+class TestTransformsAndDatasets:
+    def test_transform_pipeline(self):
+        from paddle_tpu.vision.transforms import (
+            Compose, Normalize, RandomHorizontalFlip, Resize, ToTensor)
+        t = Compose([Resize(32), RandomHorizontalFlip(0.5),
+                     ToTensor(), Normalize([0.5], [0.5])])
+        img = np.random.rand(28, 28, 1).astype(np.float32)
+        out = t(img)
+        assert out.shape == (1, 32, 32)
+
+    def test_fakedata_distribution_shared(self):
+        a = FakeData(size=10, seed=0)
+        b = FakeData(size=10, seed=5)
+        np.testing.assert_array_equal(a._base, b._base)
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(str(d / f"{i}.npy"),
+                        np.random.rand(4, 4, 3).astype(np.float32))
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (4, 4, 3) and label in (0, 1)
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.7, 0.2], [0.5, 0.3, 0.2]], np.float32))
+        lab = paddle.to_tensor(np.array([[1], [2]]))
+        correct = m.compute(pred, lab)
+        m.update(correct)
+        res = m.accumulate()
+        assert res[0] == pytest.approx(0.5)  # top1: first right, second no
+        assert res[1] == pytest.approx(0.5)  # top2: [1 in top2? yes][2? no]
+
+    def test_precision_recall(self):
+        p = metric.Precision()
+        r = metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect(self):
+        a = metric.Auc()
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+        # prob of class1 column used
+        labels = np.array([0, 0, 1, 1])
+        a.update(preds, labels)
+        assert a.accumulate() == pytest.approx(1.0, abs=1e-3)
+
+    def test_functional_accuracy(self):
+        acc = metric.accuracy(
+            paddle.to_tensor(np.array([[0.1, 0.9], [0.9, 0.1]], np.float32)),
+            paddle.to_tensor(np.array([[1], [1]])))
+        assert float(acc) == pytest.approx(0.5)
