@@ -51,22 +51,31 @@ class AmpScaler:
 
     def unscale_(self, optimizer):
         """Divide grads by the scale and detect non-finite values
-        (reference check_finite_and_unscale_op)."""
+        (reference check_finite_and_unscale_op). The finiteness check is
+        one fused device reduction + a single host sync per step — not a
+        per-param bool() round-trip (matches the reference's single
+        FoundInfinite output var)."""
         if not self._enable or self._unscaled:
             return
-        found = False
+        inv = 1.0 / self._scale
+        finite = jnp.asarray(True)
         for p in optimizer._parameters or []:
             if p.grad is None:
                 continue
-            g = p.grad.data / self._scale
-            if not bool(jnp.isfinite(g).all()):
-                found = True
+            g = p.grad.data * inv
+            finite = finite & jnp.isfinite(g).all()
             p.grad._data = g
-        self._found_inf = found
+        self._found_inf = not bool(finite)
         self._unscaled = True
 
     def minimize(self, optimizer, loss, *args, **kwargs):
-        loss.backward()
+        """Reference AmpScaler.minimize: consumes grads from the caller's
+        `scaled.backward()`; only runs backward itself if none exist."""
+        have_grads = any(p.grad is not None
+                         for p in (optimizer._parameters or [])
+                         if p.trainable)
+        if not have_grads:
+            loss.backward()
         self.step(optimizer)
         self.update()
 

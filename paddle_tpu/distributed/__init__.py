@@ -18,3 +18,6 @@ from . import parallel_layers  # noqa: F401
 from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
 from . import fleet  # noqa: F401
+from . import spmd  # noqa: F401
+from .spmd import SpmdTrainer, dp_train_step  # noqa: F401
+from .recompute import recompute, RecomputeWrapper  # noqa: F401

@@ -200,12 +200,17 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
     g = get_group(group)
     name = axis_name or (g.axis_name if g else None)
     if _in_trace(tensor) and name is not None:
-        # compiled: dynamic-slice own shard after broadcast from src
+        # Compiled path ASSUMES the full input is replicated on every rank
+        # (the common pjit case). The src rank's copy is selected with a
+        # psum mask — matching c_scatter's "root provides the data"
+        # semantics — then each rank dynamic-slices its own shard.
         def fn(a):
             idx = jax.lax.axis_index(name)
-            n = jax.lax.psum(jnp.ones((), jnp.int32), name)
+            mask = (idx == src).astype(a.dtype)
+            from_src = jax.lax.psum(a * mask, name)
             shard = a.shape[0] // g.nranks
-            return jax.lax.dynamic_slice_in_dim(a, idx * shard, shard, 0)
+            return jax.lax.dynamic_slice_in_dim(
+                from_src, idx * shard, shard, 0)
         return apply(fn, tensor, name="scatter")
     if g.nranks <= 1:
         if tensor_list:

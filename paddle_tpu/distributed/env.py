@@ -57,8 +57,8 @@ def init_parallel_env():
     if _initialized:
         return
     world = get_world_size()
-    if world > 1 and "JAX_COORDINATOR_ADDRESS" in os.environ or \
-            "PADDLE_MASTER" in os.environ:
+    if world > 1 and ("JAX_COORDINATOR_ADDRESS" in os.environ or
+                      "PADDLE_MASTER" in os.environ):
         import jax
         coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
             os.environ.get("PADDLE_MASTER")

@@ -1,0 +1,98 @@
+"""Activation recompute (checkpointing).
+
+Reference: fluid/backward.py:725 `_append_backward_ops_with_checkpoints_`
+(re-runs forward segments inside the backward program) and
+fleet/meta_optimizers/recompute_optimizer.py. TPU-native: `jax.checkpoint`
+(remat) — XLA drops the segment's activations and re-executes its forward
+in the backward pass, trading FLOPs for HBM exactly like the reference's
+program rewrite, but scheduled by the compiler.
+
+Works in BOTH execution modes:
+- eagerly, `recompute(block, x)` records ONE tape node whose vjp is the
+  checkpointed function's vjp (recompute happens inside `backward()`);
+- under a compiled trainer trace, the remat region is inlined into the
+  jaxpr and honored by jax.grad.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["recompute", "RecomputeWrapper", "checkpoint_policy"]
+
+_POLICIES = {
+    "full": None,  # save nothing, recompute everything
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def checkpoint_policy(name: Optional[str]):
+    """Map strategy.recompute_configs['policy'] names onto
+    jax.checkpoint_policies."""
+    if name is None or name == "full":
+        return None
+    attr = _POLICIES.get(name, name)
+    pol = getattr(jax.checkpoint_policies, attr, None)
+    if pol is None:
+        raise ValueError(f"unknown recompute policy {name!r}")
+    return pol
+
+
+def recompute(function, *args, policy=None, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity: run `function`
+    (a Layer or a Tensor-level callable) without saving its internal
+    activations; they are recomputed during backward.
+    """
+    if isinstance(function, Layer):
+        param_objs = [p for _, p in function.named_parameters()]
+    else:
+        param_objs = []
+    n_params = len(param_objs)
+
+    def pure(*flat):
+        p_arrs, in_arrs = flat[:n_params], flat[n_params:]
+        originals = [p._data for p in param_objs]
+        for p, a in zip(param_objs, p_arrs):
+            p._data = a
+        try:
+            wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
+                       for a in in_arrs]
+            out = function(*wrapped, **kwargs)
+        finally:
+            for p, a in zip(param_objs, originals):
+                p._data = a
+        return jax.tree_util.tree_map(
+            lambda x: x.data if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    ckpt = jax.checkpoint(pure, policy=checkpoint_policy(policy))
+    return apply(ckpt, *param_objs, *args, name="recompute")
+
+
+class RecomputeWrapper(Layer):
+    """Wrap a block so every forward goes through `recompute` (the layer
+    form of the reference's checkpoint list). `enable(False)` turns it
+    into a transparent passthrough."""
+
+    def __init__(self, layer: Layer, policy: Optional[str] = None):
+        super().__init__()
+        self._inner = layer
+        self._policy = policy
+        self._active = True
+
+    def enable(self, active: bool = True):
+        self._active = active
+        return self
+
+    def forward(self, *args, **kwargs):
+        if not self._active:
+            return self._inner(*args, **kwargs)
+        return recompute(self._inner, *args, policy=self._policy, **kwargs)

@@ -34,7 +34,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return tuple(i for i in range(a.ndim) if i != ch_axis), ch_axis
 
     if use_batch_stats:
-        # compute batch stats eagerly for the running update
+        # batch stats recomputed eagerly ONLY for the running update; the
+        # differentiated fn below recomputes them from the traced input so
+        # jax.vjp carries the d(mean)/dx and d(var)/dx terms (reference
+        # batch_norm_grad_op semantics)
         xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
         axes, ch_axis = stats_axes(xa)
         bm = jnp.mean(xa.astype(jnp.float32), axis=axes)
@@ -46,14 +49,25 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             running_var._data = (momentum * running_var.data +
                                  (1 - momentum) * bv).astype(
                                      running_var.data.dtype)
-        mean_in, var_in = bm, bv
-    else:
-        mean_in = running_mean
-        var_in = running_var
 
     has_w, has_b = weight is not None, bias is not None
 
-    def fn(a, m, v, *rest):
+    def fn_batch(a, *rest):
+        axes, ch_axis = stats_axes(a)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes).reshape(shape)
+        v = jnp.var(af, axis=axes).reshape(shape)
+        out = (af - m) * jax.lax.rsqrt(v + epsilon)
+        it = iter(rest)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return out.astype(a.dtype)
+
+    def fn_global(a, m, v, *rest):
         axes, ch_axis = stats_axes(a)
         shape = [1] * a.ndim
         shape[ch_axis] = a.shape[ch_axis]
@@ -67,7 +81,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out + next(it).reshape(shape)
         return out.astype(a.dtype)
 
-    args = [x, mean_in, var_in]
+    if use_batch_stats:
+        args = [x]
+        fn = fn_batch
+    else:
+        args = [x, running_mean, running_var]
+        fn = fn_global
     if has_w:
         args.append(weight)
     if has_b:

@@ -24,6 +24,8 @@ from . import initializer as I
 
 __all__ = ["Layer", "ParamAttr"]
 
+_layer_name_counter = 0
+
 
 class ParamAttr:
     """Parameter attribute bag (reference python/paddle/fluid/param_attr.py:
@@ -69,9 +71,17 @@ class Layer:
     fluid/dygraph/layers.py:Layer)."""
 
     def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        global _layer_name_counter
         self.training = True
         self._dtype = convert_dtype(dtype) or default_float_dtype()
-        self._full_name = name_scope or self.__class__.__name__.lower()
+        if name_scope is None:
+            # paddle-style unique scope (linear_0, linear_1, ...) so
+            # default param names are linear_0.w_0 / linear_0.b_0
+            name_scope = (f"{self.__class__.__name__.lower()}"
+                          f"_{_layer_name_counter}")
+            _layer_name_counter += 1
+        self._full_name = name_scope
+        self._param_index = {"w": 0, "b": 0}
         self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
         self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
         self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
@@ -96,7 +106,16 @@ class Layer:
             else:
                 init = gw or I.XavierUniform()
         data = init(shape, dtype)
-        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        name = attr.name
+        if name is None:
+            # paddle-style default names (linear_0.w_0 / linear_0.b_0) so
+            # name-based hooks (AdamW apply_decay_param_fun, Lamb
+            # exclude_from_weight_decay_fn) can match bias/weight params
+            kind = "b" if is_bias else "w"
+            idx = self._param_index
+            name = f"{self._full_name}.{kind}_{idx[kind]}"
+            idx[kind] += 1
+        p = Parameter(data, name=name, trainable=attr.trainable)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = attr.need_clip

@@ -29,6 +29,23 @@ from ..nn.clip import ClipGradBase
 from ..regularizer import L1Decay, L2Decay
 
 
+def _path_to_name(path) -> str:
+    """Join a jax pytree key path into a dotted name ('block.fc.weight').
+    Used so name-based decay hooks see readable structured names in the
+    functional/compiled path (the eager path passes Parameter.name)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
+
+
 class Optimizer:
     _accum_names: Sequence[str] = ()
 
@@ -46,6 +63,10 @@ class Optimizer:
         # state: param name -> dict of accumulator arrays
         self._accumulators: Dict[str, Dict[str, jax.Array]] = {}
         self._step_count = 0
+        # current-param context for per-param decay hooks (AdamW
+        # apply_decay_param_fun, Lamb exclude_from_weight_decay_fn)
+        self._cur_param_name: Optional[str] = None
+        self._cur_param = None
         self._lr_scheduler = self._lr if isinstance(
             self._lr, lr_mod.LRScheduler) else None
 
@@ -89,9 +110,6 @@ class Optimizer:
                 "Optimizer constructed without parameters; pass "
                 "parameters=model.parameters() for dygraph use.")
         lr = self.get_lr()
-        for p in self._parameters:
-            if p.grad is None or not p.trainable:
-                continue
         params_grads = [(p, p.grad) for p in self._parameters
                         if p.grad is not None and p.trainable]
         if self._grad_clip is not None:
@@ -104,6 +122,8 @@ class Optimizer:
                 self._accumulators[key] = self._init_accumulators(p.data)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else lr
+            self._cur_param_name = key
+            self._cur_param = p
             new_p, new_state = self._update(
                 p.data, garr, self._accumulators[key], plr,
                 self._step_count + 1)
@@ -113,7 +133,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        loss.backward()
+        """Reference dygraph semantics (optimizer.py minimize): grads are
+        collected, not recomputed — the canonical `loss.backward();
+        opt.minimize(loss)` must not run backward twice. Backward runs here
+        only when no parameter carries a grad yet."""
+        have_grads = any(p.grad is not None
+                         for p in (self._parameters or []) if p.trainable)
+        if not have_grads:
+            loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]
 
@@ -138,11 +165,13 @@ class Optimizer:
         if self._weight_decay is not None and not self._decoupled_wd:
             grads = jax.tree_util.tree_map(
                 lambda p, g: self._weight_decay.apply(p, g), params, grads)
-        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        paths_p, treedef = jax.tree_util.tree_flatten_with_path(params)
         leaves_g = treedef.flatten_up_to(grads)
         leaves_s = treedef.flatten_up_to(state)
         new_p, new_s = [], []
-        for p, g, s in zip(leaves_p, leaves_g, leaves_s):
+        for (path, p), g, s in zip(paths_p, leaves_g, leaves_s):
+            self._cur_param_name = _path_to_name(path)
+            self._cur_param = None
             np_, ns_ = self._update(p, g, s, lr, step)
             new_p.append(np_.astype(p.dtype))
             new_s.append(ns_)

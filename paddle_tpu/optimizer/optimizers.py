@@ -159,11 +159,23 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip)
-        self._wd_coeff = float(weight_decay) if isinstance(
-            weight_decay, (int, float)) else 0.01
+        from ..regularizer import L2Decay
+        if isinstance(weight_decay, (int, float)):
+            self._wd_coeff = float(weight_decay)
+        elif isinstance(weight_decay, L2Decay):
+            # decoupled decay is L2-shaped by definition; honor the coeff
+            self._wd_coeff = float(weight_decay.coeff)
+        else:
+            raise TypeError(
+                f"AdamW weight_decay must be a float or L2Decay, got "
+                f"{type(weight_decay)}")
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _extra_decay(self, new_p, p, lr):
+        fn = self._apply_decay_param_fun
+        if fn is not None and self._cur_param_name is not None and \
+                not fn(self._cur_param_name):
+            return new_p
         return new_p - lr * self._wd_coeff * p.astype(jnp.float32)
 
 
@@ -212,7 +224,21 @@ class Lamb(Optimizer):
         m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g32 * g32
         m1_hat = m1 / (1 - self._beta1 ** step)
         m2_hat = m2 / (1 - self._beta2 ** step)
-        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + self._wd * p32
+        wd = self._wd
+        if self._exclude_fn is not None:
+            # the hook receives a param-like object carrying .name in BOTH
+            # paths (eager: the Parameter; functional: a named stub), so
+            # one callback works under eager and compiled training
+            if self._cur_param is not None:
+                target = self._cur_param
+            elif self._cur_param_name is not None:
+                import types
+                target = types.SimpleNamespace(name=self._cur_param_name)
+            else:
+                target = None
+            if target is not None and self._exclude_fn(target):
+                wd = 0.0
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + wd * p32
         p_norm = jnp.linalg.norm(p32)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)

@@ -13,6 +13,10 @@ class WeightDecayRegularizer:
     def apply(self, p, g):
         raise NotImplementedError
 
+    @property
+    def coeff(self):
+        return self._coeff
+
 
 class L1Decay(WeightDecayRegularizer):
     def __init__(self, coeff=0.0):

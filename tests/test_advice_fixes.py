@@ -1,0 +1,124 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_batch_norm_grad_includes_stat_terms():
+    # Scale invariance: y = BN(x) is invariant to scaling x, so
+    # d/dx sum(BN(x)^2) must be ~0 when grads flow through batch stats.
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32),
+                         stop_gradient=False)
+    rm = Tensor(np.zeros(4, np.float32))
+    rv = Tensor(np.ones(4, np.float32))
+    y = F.batch_norm(x, rm, rv, training=True)
+    loss = (y * y).sum()
+    loss.backward()
+    assert np.abs(x.grad.numpy()).max() < 1e-4
+
+
+def test_batch_norm_running_stats_still_update():
+    x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32) * 3 + 1)
+    rm = Tensor(np.zeros(4, np.float32))
+    rv = Tensor(np.ones(4, np.float32))
+    F.batch_norm(x, rm, rv, training=True, momentum=0.5)
+    assert np.abs(rm.numpy()).sum() > 0.01
+    assert np.abs(rv.numpy() - 1.0).sum() > 0.01
+
+
+def test_batch_norm_layer_trains_sane():
+    # end-to-end: BN layer gradient vs numeric finite difference on weight
+    bn = paddle.nn.BatchNorm1D(3)
+    x = paddle.to_tensor(np.random.randn(6, 3).astype(np.float32),
+                         stop_gradient=False)
+    y = bn(x)
+    loss = (y * y).mean()
+    loss.backward()
+    assert bn.weight.grad is not None
+    assert np.all(np.isfinite(bn.weight.grad.numpy()))
+
+
+def test_minimize_after_backward_no_double_backward():
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    loss = lin(x).sum()
+    loss.backward()
+    opt.minimize(loss)  # must not raise / re-run backward
+
+
+def test_minimize_alone_still_runs_backward():
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    w0 = lin.weight.numpy().copy()
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    loss = lin(x).sum()
+    opt.minimize(loss)
+    assert not np.allclose(lin.weight.numpy(), w0)
+
+
+def test_scaler_minimize_after_backward():
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    loss = lin(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.minimize(opt, scaled)  # must not raise
+
+
+def test_adamw_apply_decay_param_fun():
+    lin = paddle.nn.Linear(4, 4)
+    lin2 = paddle.nn.Linear(4, 4)
+    lin2.bias.set_value(np.full(4, 10.0, np.float32))
+    opt3 = paddle.optimizer.AdamW(
+        learning_rate=0.1, parameters=lin2.parameters(), weight_decay=0.9,
+        apply_decay_param_fun=lambda n: ".b_" not in n)
+    opt4 = paddle.optimizer.AdamW(
+        learning_rate=0.1, parameters=lin2.parameters(), weight_decay=0.9)
+    # grads of zero: only decay acts
+    for p in lin2.parameters():
+        p.grad = Tensor(np.zeros(p.shape, np.float32))
+    b_before = lin2.bias.numpy().copy()
+    opt3.step()
+    b_excluded = lin2.bias.numpy().copy()
+    # bias excluded from decay AND zero grad -> unchanged
+    np.testing.assert_allclose(b_excluded, b_before, atol=1e-6)
+    for p in lin2.parameters():
+        p.grad = Tensor(np.zeros(p.shape, np.float32))
+    opt4.step()
+    b_decayed = lin2.bias.numpy().copy()
+    assert np.abs(b_decayed - b_excluded).max() > 0.01  # decay applied
+
+
+def test_adamw_honors_regularizer_weight_decay():
+    from paddle_tpu.regularizer import L2Decay
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1, parameters=[],
+        weight_decay=L2Decay(0.25))
+    assert opt._wd_coeff == 0.25
+    with pytest.raises(TypeError):
+        paddle.optimizer.AdamW(learning_rate=0.1, parameters=[],
+                               weight_decay="bogus")
+
+
+def test_lamb_exclude_from_weight_decay_fn():
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Lamb(
+        learning_rate=0.1, lamb_weight_decay=0.9,
+        parameters=lin.parameters(),
+        exclude_from_weight_decay_fn=lambda p: ".b_" in getattr(
+            p, "name", str(p)))
+    for p in lin.parameters():
+        p.grad = Tensor(np.zeros(p.shape, np.float32))
+    b0 = lin.bias.numpy().copy()
+    opt.step()
+    # zero grad + excluded decay -> trust ratio * (0 + 0) = no movement
+    np.testing.assert_allclose(lin.bias.numpy(), b0, atol=1e-6)
