@@ -70,12 +70,17 @@ class AmpScaler:
 
     def minimize(self, optimizer, loss, *args, **kwargs):
         """Reference AmpScaler.minimize: consumes grads from the caller's
-        `scaled.backward()`; only runs backward itself if none exist."""
+        `scaled.backward()`; runs backward itself only when none happened
+        since this scaler's last minimize (never reuses stale grads)."""
+        from ..core import autograd as _ag
+        fresh_backward = _ag.BACKWARD_EPOCH != getattr(
+            self, "_seen_backward_epoch", -1)
         have_grads = any(p.grad is not None
                          for p in (optimizer._parameters or [])
                          if p.trainable)
-        if not have_grads:
+        if not (have_grads and fresh_backward):
             loss.backward()
+        self._seen_backward_epoch = _ag.BACKWARD_EPOCH
         self.step(optimizer)
         self.update()
 

@@ -31,6 +31,12 @@ from .flags import GLOBAL_FLAGS
 _node_counter = itertools.count()
 _tls = threading.local()
 
+# Monotone count of completed reverse passes. Optimizer.minimize uses it
+# to distinguish "user already ran loss.backward() for THIS iteration"
+# from "grads are stale leftovers" (reference dygraph minimize collects
+# grads; it must not silently reuse last iteration's).
+BACKWARD_EPOCH = 0
+
 
 def _grad_enabled() -> bool:
     return getattr(_tls, "grad_enabled", True)
@@ -261,6 +267,8 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
     else:
         g = grad_tensor.data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
     _run_engine([tensor], [g], retain_graph=retain_graph)
+    global BACKWARD_EPOCH
+    BACKWARD_EPOCH += 1
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -50,31 +50,58 @@ def recompute(function, *args, policy=None, **kwargs):
     """paddle.distributed.fleet.utils.recompute parity: run `function`
     (a Layer or a Tensor-level callable) without saving its internal
     activations; they are recomputed during backward.
+
+    Buffers the block mutates in place (BatchNorm running stats) are
+    threaded through the checkpointed region as explicit inputs/outputs —
+    the block's buffer tensors are restored after tracing and re-assigned
+    with the region's OUTPUT values, so no inner-trace tracer ever leaks
+    into live module state.
     """
     if isinstance(function, Layer):
         param_objs = [p for _, p in function.named_parameters()]
+        buf_objs = [b for _, b in function.named_buffers()
+                    if b is not None]
     else:
-        param_objs = []
-    n_params = len(param_objs)
+        param_objs, buf_objs = [], []
+    n_params, n_bufs = len(param_objs), len(buf_objs)
+    meta = {}
 
     def pure(*flat):
-        p_arrs, in_arrs = flat[:n_params], flat[n_params:]
-        originals = [p._data for p in param_objs]
-        for p, a in zip(param_objs, p_arrs):
-            p._data = a
+        p_arrs = flat[:n_params]
+        b_arrs = flat[n_params:n_params + n_bufs]
+        in_arrs = flat[n_params + n_bufs:]
+        orig_p = [p._data for p in param_objs]
+        orig_b = [b._data for b in buf_objs]
+        for o, a in zip(param_objs, p_arrs):
+            o._data = a
+        for o, a in zip(buf_objs, b_arrs):
+            o._data = a
         try:
             wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
                        for a in in_arrs]
             out = function(*wrapped, **kwargs)
+            new_bufs = tuple(b._data for b in buf_objs)
         finally:
-            for p, a in zip(param_objs, originals):
-                p._data = a
-        return jax.tree_util.tree_map(
+            for o, a in zip(param_objs, orig_p):
+                o._data = a
+            for o, a in zip(buf_objs, orig_b):
+                o._data = a
+        out_arrs = jax.tree_util.tree_map(
             lambda x: x.data if isinstance(x, Tensor) else x, out,
             is_leaf=lambda x: isinstance(x, Tensor))
+        leaves, treedef = jax.tree_util.tree_flatten(out_arrs)
+        meta["treedef"] = treedef
+        meta["n_out"] = len(leaves)
+        return tuple(leaves) + new_bufs
 
     ckpt = jax.checkpoint(pure, policy=checkpoint_policy(policy))
-    return apply(ckpt, *param_objs, *args, name="recompute")
+    res = apply(ckpt, *param_objs, *buf_objs, *args, name="recompute")
+    res = res if isinstance(res, tuple) else (res,)
+    out_leaves = list(res[:meta["n_out"]])
+    for b, nv in zip(buf_objs, res[meta["n_out"]:]):
+        b._data = nv.data
+    out = jax.tree_util.tree_unflatten(meta["treedef"], out_leaves)
+    return out
 
 
 class RecomputeWrapper(Layer):

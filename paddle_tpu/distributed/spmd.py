@@ -119,17 +119,24 @@ class SpmdTrainer:
         self._step_count = 0
 
         st = self.strategy
-        for flag in ("localsgd", "dgc", "a_sync", "fp16_allreduce"):
-            if getattr(st, flag):
-                raise NotImplementedError(
-                    f"DistributedStrategy.{flag} is not implemented in the "
-                    f"compiled trainer; disable it or use a supported "
-                    f"strategy (amp/sharding/gradient_merge/recompute/"
-                    f"tensor_parallel)")
         if st.pipeline:
             raise NotImplementedError(
                 "strategy.pipeline: use paddle_tpu.distributed.pipeline."
                 "PipelineTrainer for pipeline parallelism")
+        # flags either work here or raise — audit EVERY enabled boolean,
+        # not a hand-picked subset (silent flags are worse than errors)
+        supported = {
+            "amp", "recompute", "sharding", "gradient_merge",
+            "tensor_parallel",          # honored via param.pspec + mesh
+            "find_unused_parameters",   # moot: XLA zero-grads unused params
+            "fuse_all_reduce_ops",      # moot: XLA fuses collectives
+            "use_hierarchical_allreduce",  # moot: XLA picks the algorithm
+        }
+        for key, val in st.to_dict().items():
+            if val is True and key not in supported:
+                raise NotImplementedError(
+                    f"DistributedStrategy.{key} is not implemented in the "
+                    f"compiled trainer; supported flags: {sorted(supported)}")
 
         self.zero_stage = int(st.sharding_configs.get("stage", 2)) \
             if st.sharding else 0
@@ -137,8 +144,12 @@ class SpmdTrainer:
             if st.gradient_merge else 1
         self.gm_avg = bool(st.gradient_merge_configs.get("avg", True))
         self.amp_enabled = bool(st.amp)
-        self.amp_dtype = jnp.bfloat16 if st.amp_configs.get(
-            "use_bf16", True) else jnp.float16
+        if self.amp_enabled and not st.amp_configs.get("use_bf16", True):
+            raise NotImplementedError(
+                "fp16 AMP (use_bf16=False) needs loss scaling which the "
+                "compiled trainer does not implement yet; use bf16 (the "
+                "TPU-native dtype, no scaling required)")
+        self.amp_dtype = jnp.bfloat16
 
         if st.recompute:
             # model must cooperate (wrap blocks in distributed.recompute);
@@ -152,6 +163,11 @@ class SpmdTrainer:
 
         # ---- state pytrees (raw arrays keyed by structured name) --------
         self._param_objs = dict(model.named_parameters())
+        # name-based decay hooks (AdamW apply_decay_param_fun, Lamb
+        # exclude fn) must see Parameter.name in the compiled path too
+        optimizer._param_name_map = {
+            n: p.name for n, p in self._param_objs.items()}
+        optimizer._param_obj_map = dict(self._param_objs)
         params = {n: p.data for n, p in self._param_objs.items()}
         buffers = {n: b.data for n, b in model.named_buffers()
                    if b is not None}
@@ -245,9 +261,15 @@ class SpmdTrainer:
     def _loss_and_buffers(self, params, buffers, inputs, labels):
         from ..core.autograd import no_grad
         if self.amp_enabled:
+            # cast params AND floating inputs: with fp32 activations JAX
+            # type promotion would silently run every matmul in fp32 and
+            # AMP would buy nothing (labels/int inputs stay untouched)
             cast = self.amp_dtype
             params = jax.tree_util.tree_map(
                 lambda a: a.astype(cast) if _is_floating(a) else a, params)
+            inputs = tuple(
+                a.astype(cast) if hasattr(a, "dtype") and _is_floating(a)
+                else a for a in inputs)
         # the eager tape is bypassed during tracing (jax.grad differentiates
         # the traced ops; recording GradNodes here would only slow compiles)
         with no_grad():

@@ -1,0 +1,5 @@
+"""Model zoo beyond vision: the flagship transformer family used by the
+benchmarks (BASELINE.json configs #3-#5)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt_configs)

@@ -67,6 +67,10 @@ class Optimizer:
         # apply_decay_param_fun, Lamb exclude_from_weight_decay_fn)
         self._cur_param_name: Optional[str] = None
         self._cur_param = None
+        # compiled trainers install these so hooks see the SAME
+        # Parameter.name (and object) in the functional path as in eager
+        self._param_name_map: Optional[Dict[str, str]] = None
+        self._param_obj_map: Optional[Dict[str, object]] = None
         self._lr_scheduler = self._lr if isinstance(
             self._lr, lr_mod.LRScheduler) else None
 
@@ -135,12 +139,18 @@ class Optimizer:
                  no_grad_set=None):
         """Reference dygraph semantics (optimizer.py minimize): grads are
         collected, not recomputed — the canonical `loss.backward();
-        opt.minimize(loss)` must not run backward twice. Backward runs here
-        only when no parameter carries a grad yet."""
+        opt.minimize(loss)` must not run backward twice. A fresh backward
+        runs here only when none happened since this optimizer's last
+        minimize (so a minimize-only loop still trains, but it never
+        silently reuses a past iteration's grads)."""
+        from ..core import autograd as _ag
+        fresh_backward = _ag.BACKWARD_EPOCH != getattr(
+            self, "_seen_backward_epoch", -1)
         have_grads = any(p.grad is not None
                          for p in (self._parameters or []) if p.trainable)
-        if not have_grads:
+        if not (have_grads and fresh_backward):
             loss.backward()
+        self._seen_backward_epoch = _ag.BACKWARD_EPOCH
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]
 
@@ -170,8 +180,13 @@ class Optimizer:
         leaves_s = treedef.flatten_up_to(state)
         new_p, new_s = [], []
         for (path, p), g, s in zip(paths_p, leaves_g, leaves_s):
-            self._cur_param_name = _path_to_name(path)
-            self._cur_param = None
+            structured = _path_to_name(path)
+            if self._param_name_map is not None:
+                self._cur_param_name = self._param_name_map.get(
+                    structured, structured)
+            else:
+                self._cur_param_name = structured
+            self._cur_param = (self._param_obj_map or {}).get(structured)
             np_, ns_ = self._update(p, g, s, lr, step)
             new_p.append(np_.astype(p.dtype))
             new_s.append(ns_)

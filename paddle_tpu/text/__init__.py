@@ -1,0 +1,22 @@
+"""paddle.text parity + the variable-length-sequence utilities the core
+doctrine points at.
+
+Reference: /root/reference/python/paddle/text/ (datasets: Conll05st, Imdb,
+Imikolov, Movielens, UCIHousing, WMT14, WMT16). The reference handles
+variable-length data with LoDTensor (lod_tensor.h:114); TPU/XLA wants
+static shapes, so this module provides the dense-padding + mask
+equivalents (`pad_sequences`, `sequence_mask`) that every model here uses
+instead of LoD.
+"""
+from .utils import (  # noqa: F401
+    sequence_mask, pad_sequences, truncate_sequences, shift_tokens_right,
+    causal_mask, padding_attn_mask)
+from .datasets import (  # noqa: F401
+    UCIHousing, Imdb, Imikolov, Movielens, WMT14, Conll05st, WMT16)
+
+__all__ = [
+    "sequence_mask", "pad_sequences", "truncate_sequences",
+    "shift_tokens_right", "causal_mask", "padding_attn_mask",
+    "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14", "WMT16",
+    "Conll05st",
+]

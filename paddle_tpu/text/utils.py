@@ -1,0 +1,86 @@
+"""Dense-padding / masking utilities — the TPU-native replacement for the
+reference's LoD (level-of-detail) variable-length machinery
+(/root/reference/paddle/fluid/framework/lod_tensor.h:62,114 and the
+sequence_ops operator family). XLA requires static shapes; ragged batches
+become [B, max_len] plus a mask, and every sequence op is a masked dense
+op the compiler can fuse.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import apply
+
+__all__ = ["sequence_mask", "pad_sequences", "truncate_sequences",
+           "shift_tokens_right", "causal_mask", "padding_attn_mask"]
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool"):
+    """[B] lengths -> [B, maxlen] mask (reference
+    fluid/layers/sequence_lod.py sequence_mask / sequence_mask_op)."""
+    arr = lengths.data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(np.asarray(arr).max())
+
+    def fn(l):
+        pos = jnp.arange(maxlen, dtype=jnp.int32)
+        return (pos[None, :] < l[..., None].astype(jnp.int32)).astype(dtype)
+
+    return apply(fn, Tensor(arr), name="sequence_mask")
+
+
+def pad_sequences(seqs: Sequence[Sequence[int]], maxlen: Optional[int] = None,
+                  pad_value=0, dtype=np.int64, truncate_from="right",
+                  return_lengths=False):
+    """Ragged python sequences -> dense [B, maxlen] numpy array (+ lengths).
+    This is where LoD data enters the static-shape world."""
+    if maxlen is None:
+        maxlen = max((len(s) for s in seqs), default=0)
+    out = np.full((len(seqs), maxlen), pad_value, dtype=dtype)
+    lengths = np.zeros((len(seqs),), np.int64)
+    for i, s in enumerate(seqs):
+        s = list(s)
+        if len(s) > maxlen:
+            s = s[-maxlen:] if truncate_from == "left" else s[:maxlen]
+        out[i, :len(s)] = s
+        lengths[i] = len(s)
+    if return_lengths:
+        return out, lengths
+    return out
+
+
+def truncate_sequences(seqs, maxlen: int, truncate_from="right"):
+    return [list(s)[-maxlen:] if truncate_from == "left" else
+            list(s)[:maxlen] for s in seqs]
+
+
+def shift_tokens_right(input_ids, pad_id: int = 0):
+    """Labels for causal LM: labels[t] = input[t+1], last position padded."""
+    arr = input_ids.data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+
+    def fn(a):
+        return jnp.concatenate(
+            [a[:, 1:], jnp.full((a.shape[0], 1), pad_id, a.dtype)], axis=1)
+
+    return apply(fn, Tensor(arr), name="shift_tokens_right")
+
+
+def causal_mask(seq_len: int, dtype="bool"):
+    """[1, 1, S, S] lower-triangular mask for decoder attention."""
+    m = jnp.tril(jnp.ones((seq_len, seq_len), bool))
+    return Tensor(m[None, None].astype(dtype))
+
+
+def padding_attn_mask(lengths, seq_len: int):
+    """[B] lengths -> [B, 1, 1, S] boolean key-padding mask usable as
+    attn_mask in scaled_dot_product_attention (broadcasts over heads and
+    query positions)."""
+    m = sequence_mask(lengths, maxlen=seq_len, dtype="bool")
+    arr = m.data
+    return Tensor(arr[:, None, None, :])

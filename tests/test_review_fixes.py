@@ -1,0 +1,136 @@
+"""Regression tests for round-2 inline review findings (spmd/recompute/
+optimizer-hook issues)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import SpmdTrainer, create_mesh, recompute
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+
+def test_recompute_with_batchnorm_buffers():
+    # buffers mutated inside the checkpointed region must come out as
+    # REAL arrays (round-2 finding: inner tracers leaked into ._mean)
+    paddle.seed(0)
+    blk = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32),
+                         stop_gradient=False)
+    y = recompute(blk, x)
+    y.sum().backward()
+    bn = blk[1]
+    mean = np.asarray(bn._mean.data)  # must not raise TracerError
+    assert np.all(np.isfinite(mean))
+    # eval-mode forward right after recompute training step
+    blk.eval()
+    out = blk(paddle.to_tensor(np.random.randn(2, 4).astype(np.float32)))
+    assert np.all(np.isfinite(out.numpy()))
+
+
+def test_minimize_only_loop_trains():
+    # round-2 finding: minimize-per-iteration without clear_grad froze on
+    # the first batch's gradients
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=lin.parameters())
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    Y = rng.randn(16, 2).astype(np.float32)
+    losses = []
+    for _ in range(5):
+        out = lin(paddle.to_tensor(X))
+        loss = F.mse_loss(out, paddle.to_tensor(Y))
+        opt.minimize(loss)
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_minimize_no_double_backward_still():
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    loss = lin(x).sum()
+    loss.backward()
+    opt.minimize(loss)  # must not re-run backward (graph is freed)
+
+
+def test_adamw_decay_fun_matches_eager_in_compiled_path():
+    # hook must receive Parameter.name under SpmdTrainer as well
+    seen = []
+
+    def decay_fun(name):
+        seen.append(name)
+        return ".b_" not in name
+
+    paddle.seed(0)
+    model = nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.9,
+                                 parameters=model.parameters(),
+                                 apply_decay_param_fun=decay_fun)
+    tr = SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
+                     mesh=create_mesh({"dp": 4}))
+    x = np.zeros((4, 8), np.float32)
+    y = np.zeros((4, 8), np.float32)
+    b_before = np.asarray(tr.params["bias"])
+    tr.train_step(x, y)
+    assert any(".b_" in n for n in seen), seen  # Parameter.name style
+    # zero grads (x=0,y=0 -> dL/db nonzero actually; just check hook names)
+
+
+def test_amp_casts_inputs_bf16():
+    captured = {}
+
+    class Probe(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            captured["dtype"] = x.dtype
+            return self.fc(x)
+
+    paddle.seed(0)
+    model = Probe()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    st = DistributedStrategy()
+    st.amp = True
+    tr = SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
+                     mesh=create_mesh({"dp": 4}), strategy=st)
+    tr.train_step(np.random.randn(4, 8).astype(np.float32),
+                  np.random.randn(4, 4).astype(np.float32))
+    assert captured["dtype"] == jnp.bfloat16
+
+
+def test_fp16_amp_raises():
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    st = DistributedStrategy()
+    st.amp = True
+    st.amp_configs = {"use_bf16": False}
+    with pytest.raises(NotImplementedError):
+        SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
+                    mesh=create_mesh({"dp": 4}), strategy=st)
+
+
+@pytest.mark.parametrize("flag", ["lars", "lamb", "localsgd", "dgc",
+                                  "elastic", "fp16_allreduce"])
+def test_every_unsupported_flag_raises(flag):
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    st = DistributedStrategy()
+    setattr(st, flag, True)
+    with pytest.raises(NotImplementedError):
+        SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
+                    mesh=create_mesh({"dp": 4}), strategy=st)
