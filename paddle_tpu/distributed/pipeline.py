@@ -1,0 +1,326 @@
+"""Pipeline parallelism — GPipe as ONE compiled SPMD program.
+
+Reference mapping: the reference implements pipelining with a C++
+scheduler (SectionWorker::TrainFiles, /root/reference/paddle/fluid/
+framework/section_worker.cc:34-110: per-microbatch scopes, run all
+Forward ops, then all Backward, then Optimize) driven by a program split
+that inserts send_v2/recv_v2 at stage boundaries
+(fluid/optimizer.py:3718 PipelineOptimizer,
+fleet/meta_optimizers/pipeline_optimizer.py:136-286).
+
+TPU-native re-design: no scheduler process at all. The whole schedule is
+a `lax.scan` over pipeline ticks inside one jitted step under
+`shard_map`:
+
+- the N identical stage blocks' parameters are STACKED on a leading
+  layer axis and sharded over the 'pp' mesh axis (each pp rank holds a
+  contiguous slab of layers) — the analogue of the reference's
+  per-device program sections;
+- at every tick each rank runs its slab (an inner `lax.scan` over its
+  layers, optionally remat'ed) and hands its activation to the next rank
+  with `lax.ppermute` — the send_v2/recv_v2 pair, but compiled into the
+  program so XLA overlaps compute with the ICI transfer;
+- rank 0 injects a fresh microbatch each tick, the last rank banks its
+  finished microbatch; after M + S - 1 ticks all M microbatches are done
+  (GPipe F-then-B: jax.grad transposes the scan, which replays the
+  ticks in reverse — exactly the reference's all-Forward-then-all-
+  Backward order, with send/recv transposed automatically);
+- embedding ("pre") and head ("post") parameters are replicated across
+  'pp'; their gradients are psum'd over the mesh.
+
+Data parallelism composes: with a ('dp', 'pp') mesh the microbatch dim
+is additionally sharded over 'dp' and gradients are psum'd over 'dp'
+inside the same program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ..core.tensor import Tensor
+from ..func import functional_call
+from ..nn.layer_base import Layer
+from .fleet.strategy import DistributedStrategy
+from .mesh import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["GPipeTrainer", "stack_block_params"]
+
+
+def stack_block_params(blocks: Sequence[Layer]) -> Dict[str, jax.Array]:
+    """Stack the (structurally identical) blocks' params on a leading
+    layer axis: {name: [L, ...]}. The per-stage slab is this array
+    sharded over 'pp' on dim 0."""
+    per_block = [dict(b.named_parameters()) for b in blocks]
+    keys = list(per_block[0].keys())
+    for d in per_block[1:]:
+        if list(d.keys()) != keys:
+            raise ValueError(
+                "pipeline stages must be structurally identical layers "
+                f"(param sets differ: {keys} vs {list(d.keys())})")
+    return {k: jnp.stack([d[k].data for d in per_block]) for k in keys}
+
+
+def _call(layer: Layer, params, *args, training=True):
+    out, _ = functional_call(layer, params, {}, *args, training=training)
+    return out
+
+
+class GPipeTrainer:
+    """Compiled GPipe trainer over a mesh with a 'pp' axis (and optional
+    'dp' axis).
+
+    Parameters
+    ----------
+    pre, blocks, post : Layers — `pre(inputs) -> h`, N identical
+        `block(h) -> h`, `post(h) -> outputs`. N must divide by the pp
+        degree. Stages must be buffer-free (like the reference's
+        SectionWorker, which forbids cross-microbatch state).
+    optimizer : functional form used inside the step.
+    loss_fn : callable(outputs, labels) -> scalar.
+    num_microbatches : GPipe M (reference pipeline_configs
+        'accumulate_steps').
+    """
+
+    def __init__(self, pre: Layer, blocks: Sequence[Layer], post: Layer,
+                 optimizer, loss_fn: Callable, mesh: Mesh,
+                 num_microbatches: int = 2, pp_axis: str = "pp",
+                 dp_axis: str = "dp", remat: bool = True,
+                 strategy: Optional[DistributedStrategy] = None):
+        if pp_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no '{pp_axis}' axis")
+        for lname, l in (("pre", pre), ("post", post), ("block", blocks[0])):
+            if any(b is not None for _, b in l.named_buffers()):
+                raise NotImplementedError(
+                    f"pipeline {lname} stage has buffers; buffer-updating "
+                    f"layers (BatchNorm) are not supported in the pipeline "
+                    f"(reference SectionWorker has the same restriction)")
+        self.pre, self.post = pre, post
+        self.template = blocks[0]
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.pp_axis, self.dp_axis = pp_axis, dp_axis
+        self.pp_size = mesh.shape[pp_axis]
+        self.dp_size = mesh.shape.get(dp_axis, 1) \
+            if dp_axis in mesh.axis_names else 1
+        self.num_micro = num_microbatches
+        self.remat = remat
+        self.num_layers = len(blocks)
+        if self.num_layers % self.pp_size:
+            raise ValueError(
+                f"{self.num_layers} blocks not divisible by pp degree "
+                f"{self.pp_size}")
+        self._step_count = 0
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        blk_shard = NamedSharding(mesh, PartitionSpec(pp_axis))
+        self._specs = {
+            "pre": {n: PartitionSpec() for n, _ in pre.named_parameters()},
+            "blocks": {k: PartitionSpec(pp_axis)
+                       for k in dict(blocks[0].named_parameters())},
+            "post": {n: PartitionSpec()
+                     for n, _ in post.named_parameters()},
+        }
+        self.params = {
+            "pre": {n: jax.device_put(p.data, repl)
+                    for n, p in pre.named_parameters()},
+            "blocks": {k: jax.device_put(v, blk_shard)
+                       for k, v in stack_block_params(blocks).items()},
+            "post": {n: jax.device_put(p.data, repl)
+                     for n, p in post.named_parameters()},
+        }
+        self._param_sharding = {
+            "pre": {n: repl for n in self.params["pre"]},
+            "blocks": {n: blk_shard for n in self.params["blocks"]},
+            "post": {n: repl for n in self.params["post"]},
+        }
+        with jax.transfer_guard("allow"):
+            opt_state = optimizer.init_state(self.params)
+
+        # opt state inherits the sharding of its param (same shapes)
+        def _st_shard(tree, shards):
+            return {k: jax.tree_util.tree_map(
+                lambda a, s=shards[k]: jax.device_put(a, s), sub)
+                for k, sub in tree.items()}
+        self.opt_state = {
+            bundle: _st_shard(opt_state[bundle],
+                              self._param_sharding[bundle])
+            for bundle in opt_state}
+        self._blocks_ref = list(blocks)
+        self._compiled = None
+
+    # ------------------------------------------------------------------
+    def _stage_fn(self, slab, h, training):
+        """Run this rank's slab of layers: inner scan over [L/S, ...]."""
+        def body(carry, layer_params):
+            out = _call(self.template, layer_params, carry,
+                        training=training)
+            return out, None
+
+        if self.remat:
+            body = jax.checkpoint(body)
+        h, _ = jax.lax.scan(body, h, slab)
+        return h
+
+    def _pipeline_forward(self, params, micro_in, micro_lab, training):
+        """Per-rank program (inside shard_map). micro_in: [M, mb, ...]."""
+        S, M = self.pp_size, self.num_micro
+        idx = jax.lax.axis_index(self.pp_axis)
+        pre_p, slab, post_p = (params["pre"], params["blocks"],
+                               params["post"])
+
+        def pre_fn(i):
+            x = jax.lax.dynamic_index_in_dim(micro_in, i, 0,
+                                             keepdims=False)
+            return _call(self.pre, pre_p, Tensor(x), training=training)
+
+        # shapes only — abstract eval, no extra stage compute emitted
+        h0_aval = jax.eval_shape(
+            lambda: self._stage_fn(slab, pre_fn(0), training))
+        zero = jnp.zeros(h0_aval.shape, h0_aval.dtype)
+        out_buf = jnp.zeros((M,) + h0_aval.shape, h0_aval.dtype)
+
+        def tick(carry, t):
+            act, out_buf = carry
+            y = self._stage_fn(slab, act, training)
+            out_idx = t - (S - 1)
+            write = (idx == S - 1) & (out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(out_buf, slot, 0,
+                                                keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(write, y, prev), slot, 0)
+            if S > 1:
+                y_next = jax.lax.ppermute(
+                    y, self.pp_axis, [(i, i + 1) for i in range(S - 1)])
+            else:
+                y_next = y
+            inj = _call(self.pre, pre_p,
+                        Tensor(jax.lax.dynamic_index_in_dim(
+                            micro_in, jnp.clip(t + 1, 0, M - 1), 0,
+                            keepdims=False)), training=training)
+            act = jnp.where(idx == 0, inj, y_next)
+            return (act, out_buf), None
+
+        # t counts processed ticks: act entering tick t is stage input
+        # for microbatch (t - stage); total M + S - 1 ticks
+        init_act = jnp.where(idx == 0, pre_fn(0), zero)
+        (act, out_buf), _ = jax.lax.scan(
+            tick, (init_act, out_buf), jnp.arange(M + S - 1))
+
+        # head + loss on every rank; only the last pp rank's is real
+        losses = []
+        for m in range(M):
+            out = _call(self.post, post_p, Tensor(out_buf[m]),
+                        training=training)
+            out_t = jax.tree_util.tree_map(
+                lambda a: Tensor(a, stop_gradient=True), out)
+            lab = jax.tree_util.tree_map(
+                lambda a: Tensor(a[m]), micro_lab)
+            lab = lab if isinstance(lab, (list, tuple)) else (lab,)
+            l = self.loss_fn(out_t, *lab)
+            losses.append((l.data if isinstance(l, Tensor) else l)
+                          .astype(jnp.float32))
+        local = jnp.stack(losses).mean()
+        masked = jnp.where(idx == S - 1, local, 0.0)
+        return masked / self.dp_size
+
+    def _build(self, training=True):
+        mesh = self.mesh
+        P = PartitionSpec
+        pp, dp = self.pp_axis, self.dp_axis
+        has_dp = self.dp_size > 1
+
+        in_specs_params = {
+            "pre": self._specs["pre"], "blocks": self._specs["blocks"],
+            "post": self._specs["post"]}
+        batch_spec = P(None, dp) if has_dp else P()
+
+        def local_step(params, micro_in, micro_lab):
+            def lfn(ps):
+                return self._pipeline_forward(ps, micro_in, micro_lab,
+                                              training)
+            loss, grads = jax.value_and_grad(lfn)(params)
+            # replicated pre/post: contributions live on specific pp
+            # ranks — sum them; slab grads are rank-local over pp
+            axes_repl = (pp, dp) if has_dp else (pp,)
+            grads = {
+                "pre": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axes_repl), grads["pre"]),
+                "blocks": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, dp) if has_dp else g,
+                    grads["blocks"]),
+                "post": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axes_repl), grads["post"]),
+            }
+            loss = jax.lax.psum(loss, axes_repl)
+            return loss, grads
+
+        grad_specs = dict(in_specs_params)
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(in_specs_params, batch_spec, batch_spec),
+            out_specs=(P(), grad_specs),
+            check_vma=False)
+
+        def step(params, opt_state, lr, step_no, micro_in, micro_lab):
+            loss, grads = smapped(params, micro_in, micro_lab)
+            new_params, new_opt = self.optimizer.apply_gradients(
+                params, grads, opt_state, lr=lr, step=step_no)
+            return new_params, new_opt, loss
+
+        return jax.jit(
+            step,
+            out_shardings=(self._param_sharding, None, None),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def _microbatch(self, arr):
+        """[B, ...] -> [M, B/M, ...] host-side split + device_put sharded
+        over dp on the microbatch dim."""
+        a = arr.data if isinstance(arr, Tensor) else jnp.asarray(arr)
+        b = a.shape[0]
+        if b % self.num_micro:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"{self.num_micro} microbatches")
+        mb = a.reshape((self.num_micro, b // self.num_micro) + a.shape[1:])
+        spec = PartitionSpec(
+            None, self.dp_axis if (self.dp_size > 1 and
+                                   mb.shape[1] % self.dp_size == 0)
+            else None, *([None] * (mb.ndim - 2)))
+        return jax.device_put(mb, NamedSharding(self.mesh, spec))
+
+    def train_step(self, inputs, labels):
+        micro_in = self._microbatch(inputs)
+        micro_lab = jax.tree_util.tree_map(
+            self._microbatch, labels,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        if self._compiled is None:
+            self._compiled = self._build(training=True)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self._step_count + 1, jnp.int32)
+        self.params, self.opt_state, loss = self._compiled(
+            self.params, self.opt_state, lr, step_no, micro_in, micro_lab)
+        self._step_count += 1
+        self.optimizer._step_count = self._step_count
+        return loss
+
+    # ------------------------------------------------------------------
+    def sync_to_model(self):
+        """Write trained arrays back into the source layers (unstacking
+        the block slabs)."""
+        for n, p in self.pre.named_parameters():
+            p._data = self.params["pre"][n]
+        for n, p in self.post.named_parameters():
+            p._data = self.params["post"][n]
+        for k, stacked in self.params["blocks"].items():
+            host = np.asarray(stacked)
+            for i, blk in enumerate(self._blocks_ref):
+                dict(blk.named_parameters())[k]._data = jnp.asarray(host[i])
+        return self

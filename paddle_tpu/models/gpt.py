@@ -287,6 +287,47 @@ class GPTForCausalLM(Layer):
         return logits
 
 
+class GPTEmbeddingStage(Layer):
+    """Pipeline 'pre' stage: token + position embedding (shares the
+    underlying parameters with the source model)."""
+
+    def __init__(self, wte, wpe, drop):
+        super().__init__()
+        self.wte, self.wpe, self.drop = wte, wpe, drop
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTHeadStage(Layer):
+    """Pipeline 'post' stage: final norm + untied LM head."""
+
+    def __init__(self, ln_f, lm_head):
+        super().__init__()
+        self.ln_f, self.lm_head = ln_f, lm_head
+
+    def forward(self, h):
+        return self.lm_head(self.ln_f(h))
+
+
+def gpt_pipeline_parts(model: "GPTForCausalLM"):
+    """Split a GPTForCausalLM into (pre, blocks, post) stage views for
+    GPipeTrainer — the analogue of the reference PipelineOptimizer's
+    program split by op_device (fluid/optimizer.py:3718), but the split
+    is BY CONSTRUCTION (embedding / N identical blocks / head) instead
+    of by annotation. Requires tie_word_embeddings=False: tied weights
+    would put one parameter on two pipeline stages."""
+    if model.cfg.tie_word_embeddings:
+        raise ValueError(
+            "pipeline parallelism needs tie_word_embeddings=False (tied "
+            "embedding+head would live on both the first and last stage)")
+    pre = GPTEmbeddingStage(model.gpt.wte, model.gpt.wpe, model.gpt.drop)
+    post = GPTHeadStage(model.gpt.ln_f, model.lm_head)
+    return pre, list(model.gpt.blocks), post
+
+
 class GPTPretrainingCriterion(Layer):
     """Shifted-token cross entropy with optional loss mask (the reference
     trains GPT with a masked LM loss over ignored pad positions)."""
