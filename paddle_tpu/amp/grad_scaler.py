@@ -72,15 +72,7 @@ class AmpScaler:
         """Reference AmpScaler.minimize: consumes grads from the caller's
         `scaled.backward()`; runs backward itself only when none happened
         since this scaler's last minimize (never reuses stale grads)."""
-        from ..core import autograd as _ag
-        fresh_backward = _ag.BACKWARD_EPOCH != getattr(
-            self, "_seen_backward_epoch", -1)
-        have_grads = any(p.grad is not None
-                         for p in (optimizer._parameters or [])
-                         if p.trainable)
-        if not (have_grads and fresh_backward):
-            loss.backward()
-        self._seen_backward_epoch = _ag.BACKWARD_EPOCH
+        optimizer._ensure_fresh_grads(loss)
         self.step(optimizer)
         self.update()
 

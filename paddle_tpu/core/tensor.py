@@ -147,6 +147,12 @@ class Tensor:
         else:
             self.grad = Tensor(self.grad._data + g, stop_gradient=True,
                                name=self.name + "@GRAD")
+        # Stamp which backward pass wrote this grad, so each optimizer's
+        # minimize() can tell ITS grads are fresh (a global epoch would let
+        # optimizer B's backward mask optimizer A's stale grads). +1 because
+        # BACKWARD_EPOCH increments after the engine run: engine-written
+        # grads must never share epoch 0 with manually-assigned ones.
+        self.grad._bw_epoch = autograd.BACKWARD_EPOCH + 1
 
     def detach(self):
         t = Tensor(self._data, stop_gradient=True, name=self.name)

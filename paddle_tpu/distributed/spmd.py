@@ -122,7 +122,7 @@ class SpmdTrainer:
         if st.pipeline:
             raise NotImplementedError(
                 "strategy.pipeline: use paddle_tpu.distributed.pipeline."
-                "PipelineTrainer for pipeline parallelism")
+                "GPipeTrainer for pipeline parallelism")
         # flags either work here or raise — audit EVERY enabled boolean,
         # not a hand-picked subset (silent flags are worse than errors)
         supported = {

@@ -157,8 +157,12 @@ class GPTAttention(Layer):
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
 
-        causal = cache is None
-        if cfg.use_flash_attention and attn_mask is None:
+        # Any multi-token call is causal — including prefill with a cache
+        # (the composite's bottom-right-aligned mask lets query i see keys
+        # <= past + i). Only single-token decode attends unmasked.
+        causal = s > 1
+        empty_cache = cache is None or cache[0] is None
+        if cfg.use_flash_attention and attn_mask is None and empty_cache:
             out = F.flash_attention(q, k, v, dropout=cfg.attn_dropout,
                                     causal=causal,
                                     training=self.training)

@@ -24,7 +24,9 @@ from . import initializer as I
 
 __all__ = ["Layer", "ParamAttr"]
 
-_layer_name_counter = 0
+# per-prefix counters: linear_0, layer_norm_0, linear_1 — reference
+# unique_name semantics, not one global sequence across all classes
+_layer_name_counters: Dict[str, int] = {}
 
 
 class ParamAttr:
@@ -71,15 +73,15 @@ class Layer:
     fluid/dygraph/layers.py:Layer)."""
 
     def __init__(self, name_scope: Optional[str] = None, dtype=None):
-        global _layer_name_counter
         self.training = True
         self._dtype = convert_dtype(dtype) or default_float_dtype()
         if name_scope is None:
             # paddle-style unique scope (linear_0, linear_1, ...) so
             # default param names are linear_0.w_0 / linear_0.b_0
-            name_scope = (f"{self.__class__.__name__.lower()}"
-                          f"_{_layer_name_counter}")
-            _layer_name_counter += 1
+            prefix = self.__class__.__name__.lower()
+            idx = _layer_name_counters.get(prefix, 0)
+            _layer_name_counters[prefix] = idx + 1
+            name_scope = f"{prefix}_{idx}"
         self._full_name = name_scope
         self._param_index = {"w": 0, "b": 0}
         self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()

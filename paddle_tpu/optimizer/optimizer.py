@@ -135,22 +135,36 @@ class Optimizer:
             self._accumulators[key] = new_state
         self._step_count += 1
 
+    def _grad_stamp(self) -> int:
+        """Newest backward-epoch stamp among THIS optimizer's grads (-1 if
+        no grads). Grads written by the engine carry `_bw_epoch`
+        (core/tensor.py `_accumulate_grad`); manually-assigned grads count
+        as epoch 0 so a first minimize() consumes them."""
+        newest = -1
+        for p in self._parameters or []:
+            if p.trainable and p.grad is not None:
+                newest = max(newest, getattr(p.grad, "_bw_epoch", 0))
+        return newest
+
+    def _ensure_fresh_grads(self, loss):
+        """Run loss.backward() only if no backward wrote this optimizer's
+        grads since its last minimize; record the consumed stamp. Shared by
+        Optimizer.minimize and AmpScaler.minimize."""
+        stamp = self._grad_stamp()
+        if stamp <= getattr(self, "_seen_grad_stamp", -1):
+            loss.backward()
+            stamp = self._grad_stamp()
+        self._seen_grad_stamp = stamp
+
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         """Reference dygraph semantics (optimizer.py minimize): grads are
         collected, not recomputed — the canonical `loss.backward();
         opt.minimize(loss)` must not run backward twice. A fresh backward
-        runs here only when none happened since this optimizer's last
-        minimize (so a minimize-only loop still trains, but it never
-        silently reuses a past iteration's grads)."""
-        from ..core import autograd as _ag
-        fresh_backward = _ag.BACKWARD_EPOCH != getattr(
-            self, "_seen_backward_epoch", -1)
-        have_grads = any(p.grad is not None
-                         for p in (self._parameters or []) if p.trainable)
-        if not (have_grads and fresh_backward):
-            loss.backward()
-        self._seen_backward_epoch = _ag.BACKWARD_EPOCH
+        runs here only when none happened for THIS optimizer's parameters
+        since its last minimize (a global backward counter would let a
+        second model's backward mask this one's stale grads)."""
+        self._ensure_fresh_grads(loss)
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]
 
@@ -187,7 +201,12 @@ class Optimizer:
             else:
                 self._cur_param_name = structured
             self._cur_param = (self._param_obj_map or {}).get(structured)
-            np_, ns_ = self._update(p, g, s, lr, step)
+            plr = lr
+            if self._cur_param is not None and hasattr(
+                    self._cur_param, "optimize_attr"):
+                plr = lr * self._cur_param.optimize_attr.get(
+                    "learning_rate", 1.0)
+            np_, ns_ = self._update(p, g, s, plr, step)
             new_p.append(np_.astype(p.dtype))
             new_s.append(ns_)
         return (jax.tree_util.tree_unflatten(treedef, new_p),
