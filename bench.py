@@ -1,0 +1,194 @@
+"""Driver perf contract: GPT train-step throughput + MFU on one chip.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": "gpt_train_mfu", "value": <MFU %>, "unit": "%", "vs_baseline":
+   <MFU/45%>, "tokens_per_sec_per_chip": ..., "config": ..., ...}
+Everything else (progress, the flash-attention microbench in --flash mode)
+goes to stderr.
+
+The measured workload is the framework's hot path: SpmdTrainer's single
+fused XLA executable (fwd+bwd+Adam update) on a 1-device mesh, bf16 AMP,
+activation recompute, flash attention — GPT-3 config at sequence 2048
+(BASELINE.json config #4; the 45% MFU north star is the baseline).
+Reference role: operators/benchmark/op_tester.cc:1 (in-tree perf harness).
+"""
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# peak dense bf16 FLOP/s per chip by device kind (public spec sheets)
+_PEAK_BF16 = {
+    "v5 lite": 394e12, "v5e": 394e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+    "v3": 61.5e12,  # per chip-half (device == core on v3)
+    "v2": 22.5e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key in sorted(_PEAK_BF16, key=len, reverse=True):
+        if key in kind:
+            return _PEAK_BF16[key]
+    return 0.0
+
+
+def bench_train(config_name, batch, seq, steps, warmup):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import SpmdTrainer, create_mesh
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.models.gpt import gpt_configs
+    from dataclasses import replace
+    import jax
+
+    cfg = replace(gpt_configs()[config_name], max_seq_len=seq)
+    log(f"bench: {config_name} seq={seq} batch={batch} "
+        f"({cfg.num_params()/1e6:.0f}M params)")
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    crit = GPTPretrainingCriterion()
+    st = DistributedStrategy()
+    st.amp = True                      # bf16 params + activations
+    st.recompute = True                # remat every block
+    model.enable_recompute()
+    mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh,
+                          strategy=st)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        loss = trainer.train_step(ids, labels)
+    loss.block_until_ready()
+    log(f"  warmup+compile {time.perf_counter() - t0:.1f}s "
+        f"loss={float(loss):.4f}")
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.train_step(ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1e3
+    tokens_per_sec = batch * seq * steps / dt
+    flops_tok = cfg.flops_per_token(seq)
+    peak = peak_flops(jax.devices()[0])
+    mfu = tokens_per_sec * flops_tok / peak if peak else 0.0
+    return {
+        "config": config_name, "batch": batch, "seq": seq,
+        "steps": steps, "step_ms": round(step_ms, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "flops_per_token": flops_tok,
+        "peak_flops": peak, "mfu": mfu,
+        "loss": float(loss),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+
+
+def bench_flash(seqs=(1024, 2048, 4096)):
+    """Secondary microbench: Pallas flash vs XLA composite, fwd+bwd."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import ops as _ops
+    from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+    rows = []
+    for s in seqs:
+        q = jnp.asarray(np.random.RandomState(0)
+                        .randn(4, s, 12, 64).astype(np.float32) * 0.1,
+                        dtype=jnp.bfloat16)
+
+        def run(fn):
+            lfn = jax.jit(jax.grad(
+                lambda q_, k_, v_: fn(q_, k_, v_).astype(jnp.float32)
+                .sum()))
+            g = lfn(q, q, q)
+            g.block_until_ready()
+            n, t0 = 10, time.perf_counter()
+            for _ in range(n):
+                g = lfn(q, q, q)
+            g.block_until_ready()
+            return (time.perf_counter() - t0) / n * 1e3
+
+        comp_ms = run(lambda a, b, c: _sdpa_reference(
+            a, b, c, is_causal=True))
+        row = {"seq": s, "composite_ms": round(comp_ms, 2)}
+        if _ops.flash_attention_available():
+            flash_ms = run(lambda a, b, c: _ops.flash_attention(
+                a, b, c, causal=True))
+            row["flash_ms"] = round(flash_ms, 2)
+            row["speedup"] = round(comp_ms / flash_ms, 2)
+        rows.append(row)
+        log(f"  flash bench {row}")
+    return rows
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    log(f"bench: platform={dev.platform} "
+        f"kind={getattr(dev, 'device_kind', '?')}")
+
+    if "--flash" in sys.argv:
+        rows = bench_flash()
+        print(json.dumps({"metric": "flash_attention_bench", "rows": rows}))
+        return
+
+    if on_tpu:
+        attempts = [("gpt3-350m", 8, 2048, 20, 3),
+                    ("gpt3-125m", 16, 2048, 20, 3),
+                    ("gpt3-125m", 8, 2048, 20, 3)]
+    else:
+        attempts = [("gpt3-tiny", 4, 256, 5, 2)]
+    if os.environ.get("BENCH_CONFIG"):
+        attempts = [(os.environ["BENCH_CONFIG"],
+                     int(os.environ.get("BENCH_BATCH", 8)),
+                     int(os.environ.get("BENCH_SEQ", 2048)), 20, 3)] \
+            + attempts
+
+    result, last_err = None, None
+    for config_name, batch, seq, steps, warmup in attempts:
+        try:
+            result = bench_train(config_name, batch, seq, steps, warmup)
+            break
+        except Exception as e:  # OOM etc: fall back to a smaller config
+            last_err = e
+            log(f"  {config_name} b{batch} failed: "
+                f"{type(e).__name__}: {str(e)[:300]}")
+    if result is None:
+        raise SystemExit(f"all bench configs failed: {last_err}")
+
+    out = {
+        "metric": "gpt_train_mfu",
+        "value": round(result["mfu"] * 100, 2),
+        "unit": "%",
+        # BASELINE.json north star: >=45% MFU
+        "vs_baseline": round(result["mfu"] / 0.45, 4) if result["mfu"]
+        else 0.0,
+    }
+    out.update(result)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
