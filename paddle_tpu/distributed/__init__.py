@@ -21,3 +21,6 @@ from . import fleet  # noqa: F401
 from . import spmd  # noqa: F401
 from .spmd import SpmdTrainer, dp_train_step  # noqa: F401
 from .recompute import recompute, RecomputeWrapper  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import (  # noqa: F401
+    MoELayer, ExpertParallelFFN, collect_aux_losses, add_aux_loss)

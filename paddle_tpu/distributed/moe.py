@@ -1,0 +1,311 @@
+"""Mixture-of-Experts / expert parallelism — a from-scratch TPU design.
+
+The reference snapshot has NO MoE and NO all-to-all collective (SURVEY.md
+§2.5 marks expert parallelism "ABSENT — design fresh: ICI all-to-all"),
+so unlike the rest of the framework there is no reference file to match;
+BASELINE.json config #5 (ERNIE-MoE / switch-transformer) is the target
+workload.
+
+Design (GShard/Switch-transformer dispatch, expressed two ways):
+
+1. COMPILED GSPMD path (the one SpmdTrainer uses): expert weights are
+   stacked [E, ...] and sharded over the 'ep' mesh axis; tokens are
+   grouped by batch row and dispatched into an [B, E, C, H] buffer with
+   one-hot einsums. Resharding that buffer from token-sharded ('dp' on B)
+   to expert-sharded ('ep' on E) is exactly the all-to-all over ICI —
+   GSPMD inserts it from the sharding constraint, the same way it inserts
+   the grad all-reduce over 'dp'.
+
+2. MANUAL shard_map path: inside shard_map with the 'ep' axis bound the
+   dispatch/exchange/combine is written with explicit
+   ``lax.all_to_all`` (dispatch E->devices, expert FFN on local experts,
+   all_to_all back). Both paths compute the same math; the manual one is
+   the single-axis (dp==ep) formulation.
+
+Gating: top-k router with capacity factor; tokens beyond an expert's
+capacity C = ceil(cf * k * S / E) are dropped (their combine weight is
+zero and the residual connection carries them — Switch semantics). The
+load-balance auxiliary loss is E * sum_e(frac_tokens_e * mean_prob_e)
+(Switch eq. 4), optionally plus a router z-loss; they reach the training
+loss through the collect_aux_losses() collector, which the compiled
+trainers open around the model call.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn import initializer as I
+from ..nn.layer_base import Layer, ParamAttr
+from .mesh import PartitionSpec, get_mesh, NamedSharding
+from .parallel_layers import mark_sharding, _in_shard_map
+
+__all__ = ["MoELayer", "ExpertParallelFFN", "top_k_gating",
+           "collect_aux_losses", "add_aux_loss", "moe_capacity"]
+
+
+# ---------------------------------------------------------------------------
+# Auxiliary-loss collection: MoE routers produce losses deep inside the
+# network that must reach the optimizer's loss. The compiled trainers open
+# a collector around the forward; eager users do the same explicitly.
+# ---------------------------------------------------------------------------
+_AUX_STACK: List[list] = []
+
+
+@contextlib.contextmanager
+def collect_aux_losses():
+    """Collect auxiliary losses (router load-balance/z-loss) produced by
+    layers during a forward pass. Yields a list the caller sums into the
+    training loss."""
+    bucket: list = []
+    _AUX_STACK.append(bucket)
+    try:
+        yield bucket
+    finally:
+        _AUX_STACK.pop()
+
+
+def add_aux_loss(loss):
+    """Layers call this with a scalar Tensor; it lands in the innermost
+    open collector (no-op when none is open, e.g. pure inference)."""
+    if _AUX_STACK:
+        _AUX_STACK[-1].append(loss)
+
+
+def moe_capacity(tokens_per_group: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Expert capacity per token group (Switch: cf * k * S / E)."""
+    return max(1, int(math.ceil(
+        capacity_factor * top_k * tokens_per_group / num_experts)))
+
+
+# ---------------------------------------------------------------------------
+# Router math (pure jnp — used under both dispatch paths)
+# ---------------------------------------------------------------------------
+def top_k_gating(logits, top_k: int, capacity: int,
+                 normalize_gates: bool = True):
+    """Top-k gating with per-group capacity.
+
+    logits: [B, S, E] router scores (a group = one batch row).
+    Returns (dispatch [B,S,E,C] 0/1, combine [B,S,E,C] float, aux, zloss):
+      - dispatch[b,s,e,c]=1 iff token s goes to expert e at capacity
+        slot c;
+      - combine = dispatch * renormalized gate prob;
+      - aux = E * sum_e(load_frac_e * mean_prob_e) (Switch load-balance);
+      - zloss = mean(logsumexp(logits)^2) (router logit drift control).
+    """
+    f32 = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(f32, axis=-1)                       # [B,S,E]
+    n_experts = probs.shape[-1]
+
+    masks, gates = [], []
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                   # [B,S]
+        m = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)  # [B,S,E]
+        masks.append(m)
+        gates.append(jnp.sum(probs * m, axis=-1))              # [B,S]
+        remaining = remaining * (1.0 - m)
+
+    # load-balance aux from the top-1 assignment (Switch eq. 4)
+    load_frac = jnp.mean(masks[0], axis=1)                     # [B,E]
+    mean_prob = jnp.mean(probs, axis=1)                        # [B,E]
+    aux = n_experts * jnp.mean(jnp.sum(load_frac * mean_prob, axis=-1))
+    zloss = jnp.mean(jnp.square(jax.nn.logsumexp(f32, axis=-1)))
+
+    if normalize_gates and top_k > 1:
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+
+    dispatch = jnp.zeros(probs.shape + (capacity,), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    # running per-expert fill count across the k choices
+    offset = jnp.zeros(probs.shape[:1] + (1, n_experts), jnp.float32)
+    for m, g in zip(masks, gates):
+        pos_e = jnp.cumsum(m, axis=1) - m + offset             # [B,S,E]
+        offset = offset + jnp.sum(m, axis=1, keepdims=True)
+        pos = jnp.sum(pos_e * m, axis=-1)                      # [B,S]
+        keep = (pos < capacity) & (jnp.sum(m, axis=-1) > 0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32) * keep[..., None]
+        d = m[..., :, None] * slot[..., None, :]       # [B,S,E,C]
+        dispatch = dispatch + d
+        combine = combine + d * g[..., None, None]
+    return dispatch, combine, aux, zloss
+
+
+# ---------------------------------------------------------------------------
+# Layers
+# ---------------------------------------------------------------------------
+class ExpertParallelFFN(Layer):
+    """E stacked FFN experts, weights sharded over the 'ep' mesh axis.
+
+    Parameters are the batched analogue of GPTMLP: w_up [E, H, F],
+    w_down [E, F, H]; each expert e computes
+    down(act(up(x_e))) on its capacity slice.
+    """
+
+    def __init__(self, num_experts: int, hidden_size: int, ffn_size: int,
+                 weight_attr=None, down_weight_attr=None,
+                 ep_axis: str = "ep", activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.hidden_size = hidden_size
+        self.ffn_size = ffn_size
+        self.ep_axis = ep_axis
+        self.activation = activation
+        self.w_up = self.create_parameter(
+            [num_experts, hidden_size, ffn_size], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.b_up = self.create_parameter(
+            [num_experts, ffn_size], is_bias=True)
+        self.w_down = self.create_parameter(
+            [num_experts, ffn_size, hidden_size],
+            attr=down_weight_attr or weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.b_down = self.create_parameter(
+            [num_experts, hidden_size], is_bias=True)
+        for p in (self.w_up, self.b_up, self.w_down, self.b_down):
+            mark_sharding(p, PartitionSpec(ep_axis,
+                                           *([None] * (p.ndim - 1))))
+
+    def act(self, x):
+        if self.activation == "gelu":
+            return jax.nn.gelu(x, approximate=True)
+        if self.activation == "relu":
+            return jax.nn.relu(x)
+        raise ValueError(f"unknown activation {self.activation}")
+
+
+class MoELayer(Layer):
+    """Switch/GShard MoE layer: router + expert-parallel FFN + combine.
+
+    Drop-in replacement for an MLP block: forward(x [B,S,H]) -> [B,S,H].
+    Router aux losses are emitted via add_aux_loss() (scaled by
+    aux_loss_coeff / z_loss_coeff) AND kept on self.last_aux_loss for
+    direct inspection.
+    """
+
+    def __init__(self, hidden_size: int, ffn_size: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 aux_loss_coeff: float = 0.01, z_loss_coeff: float = 0.0,
+                 normalize_gates: bool = True, ep_axis: str = "ep",
+                 weight_attr=None, down_weight_attr=None,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.aux_loss_coeff = aux_loss_coeff
+        self.z_loss_coeff = z_loss_coeff
+        self.normalize_gates = normalize_gates
+        self.ep_axis = ep_axis
+        self.gate = self.create_parameter(
+            [hidden_size, num_experts],
+            attr=weight_attr, default_initializer=I.Normal(0.0, 0.02))
+        # router stays replicated: every device scores its own tokens
+        mark_sharding(self.gate, PartitionSpec(None, None))
+        self.experts = ExpertParallelFFN(
+            num_experts, hidden_size, ffn_size, weight_attr=weight_attr,
+            down_weight_attr=down_weight_attr, ep_axis=ep_axis,
+            activation=activation)
+        self.last_aux_loss: Optional[Tensor] = None
+
+    # -- dense/GSPMD formulation -------------------------------------
+    def _fn_dense(self, x, gate, w_up, b_up, w_down, b_down):
+        s = x.shape[1]
+        cap = moe_capacity(s, self.num_experts, self.top_k,
+                           self.capacity_factor)
+        logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), gate)
+        dispatch, combine, aux, zloss = top_k_gating(
+            logits, self.top_k, cap, self.normalize_gates)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+        # token->expert buffer; resharding B('dp') -> E('ep') here IS the
+        # all-to-all, inserted by GSPMD from the sharding constraint
+        xe = jnp.einsum("bsec,bsh->bech", dispatch, x)   # [B,E,C,H]
+        xe = self._constrain(xe, PartitionSpec("dp", self.ep_axis,
+                                               None, None))
+        h1 = self.experts.act(
+            jnp.einsum("bech,ehf->becf", xe, w_up.astype(x.dtype))
+            + b_up.astype(x.dtype)[None, :, None, :])
+        ye = jnp.einsum("becf,efh->bech", h1, w_down.astype(x.dtype)) \
+            + b_down.astype(x.dtype)[None, :, None, :]
+        ye = self._constrain(ye, PartitionSpec("dp", self.ep_axis,
+                                               None, None))
+        y = jnp.einsum("bsec,bech->bsh", combine, ye)
+        return y, aux, zloss
+
+    # -- explicit all_to_all formulation (inside shard_map, dp==ep) --
+    def _fn_shard_map(self, x, gate, w_up, b_up, w_down, b_down):
+        axis = self.ep_axis
+        world = jax.lax.axis_size(axis)
+        b, s, h = x.shape                       # local batch shard
+        e_loc = w_up.shape[0]                   # local experts
+        n_exp = e_loc * world
+        cap = moe_capacity(s, n_exp, self.top_k, self.capacity_factor)
+        logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), gate)
+        dispatch, combine, aux, zloss = top_k_gating(
+            logits, self.top_k, cap, self.normalize_gates)
+        aux = jax.lax.pmean(aux, axis)
+        zloss = jax.lax.pmean(zloss, axis)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+        xe = jnp.einsum("bsec,bsh->ebch", dispatch, x)   # [E,b,C,H]
+        xe = xe.reshape(n_exp, b * cap, h)
+        # dispatch: each device keeps its expert rows of everyone's tokens
+        xe = jax.lax.all_to_all(xe, axis, split_axis=0, concat_axis=1,
+                                tiled=True)              # [E_loc, W*b*C, H]
+        h1 = self.experts.act(
+            jnp.einsum("egh,ehf->egf", xe, w_up.astype(x.dtype))
+            + b_up.astype(x.dtype)[:, None, :])
+        ye = jnp.einsum("egf,efh->egh", h1, w_down.astype(x.dtype)) \
+            + b_down.astype(x.dtype)[:, None, :]
+        # combine: return expert outputs to the token owners
+        ye = jax.lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
+                                tiled=True)              # [E, b*C, H]
+        ye = ye.reshape(n_exp, b, cap, h)
+        y = jnp.einsum("bsec,ebch->bsh", combine, ye)
+        return y, aux, zloss
+
+    def _constrain(self, arr, spec: PartitionSpec):
+        """Best-effort sharding constraint: applied when the ambient mesh
+        (set by the compiled trainer via mesh_guard while tracing) carries
+        the named axes. Identity outside a mesh — GSPMD propagation from
+        the sharded expert weights still finds the layout — and inside
+        shard_map manual mode, where per-device constraints over a global
+        mesh would be wrong."""
+        mesh = get_mesh()
+        if mesh is None or not isinstance(arr, jax.core.Tracer):
+            return arr
+        if any(_in_shard_map(a) for a in mesh.axis_names):
+            return arr
+        names = [a if (a in mesh.axis_names and mesh.shape[a] > 1)
+                 else None for a in spec]
+        if not any(names):
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, PartitionSpec(*names)))
+
+    def forward(self, x):
+        fn = self._fn_shard_map if _in_shard_map(self.ep_axis) \
+            else self._fn_dense
+        y, aux, zloss = apply(
+            fn, x, self.gate, self.experts.w_up, self.experts.b_up,
+            self.experts.w_down, self.experts.b_down, name="moe_layer")
+        total_aux = aux * self.aux_loss_coeff
+        if self.z_loss_coeff:
+            total_aux = total_aux + zloss * self.z_loss_coeff
+        # keep for inspection only when concrete — storing a trace-time
+        # tracer would raise UnexpectedTracerError on later reads
+        arr = total_aux.data if isinstance(total_aux, Tensor) else total_aux
+        self.last_aux_loss = None if isinstance(arr, jax.core.Tracer) \
+            else total_aux
+        add_aux_loss(total_aux)
+        return y

@@ -100,6 +100,14 @@ class GPipeTrainer:
                     f"pipeline {lname} stage has buffers; buffer-updating "
                     f"layers (BatchNorm) are not supported in the pipeline "
                     f"(reference SectionWorker has the same restriction)")
+        # MoE routers emit aux losses; blocks and post thread them through
+        # the schedule, but the pre stage runs inside the tick scan where
+        # they would be dropped silently — fail loudly instead
+        from .moe import MoELayer
+        if any(isinstance(sl, MoELayer) for sl in pre.sublayers(True)):
+            raise NotImplementedError(
+                "MoE layers in the pipeline 'pre' stage are not supported "
+                "(their router aux losses cannot leave the injection scan)")
         self.pre, self.post = pre, post
         self.template = blocks[0]
         self.optimizer = optimizer
@@ -157,16 +165,25 @@ class GPipeTrainer:
 
     # ------------------------------------------------------------------
     def _stage_fn(self, slab, h, training):
-        """Run this rank's slab of layers: inner scan over [L/S, ...]."""
+        """Run this rank's slab of layers: inner scan over [L/S, ...].
+        Returns (h, aux): aux losses (MoE routers) produced inside the
+        layer scan leave it as explicit scan outputs."""
+        from .moe import collect_aux_losses
+
         def body(carry, layer_params):
-            out = _call(self.template, layer_params, carry,
-                        training=training)
-            return out, None
+            with collect_aux_losses() as aux:
+                out = _call(self.template, layer_params, carry,
+                            training=training)
+            asum = jnp.float32(0.0)
+            for a in aux:
+                asum = asum + (a.data if isinstance(a, Tensor)
+                               else a).astype(jnp.float32)
+            return out, asum
 
         if self.remat:
             body = jax.checkpoint(body)
-        h, _ = jax.lax.scan(body, h, slab)
-        return h
+        h, auxs = jax.lax.scan(body, h, slab)
+        return h, jnp.sum(auxs)
 
     def _pipeline_forward(self, params, micro_in, micro_lab, training):
         """Per-rank program (inside shard_map). micro_in: [M, mb, ...]."""
@@ -182,13 +199,17 @@ class GPipeTrainer:
 
         # shapes only — abstract eval, no extra stage compute emitted
         h0_aval = jax.eval_shape(
-            lambda: self._stage_fn(slab, pre_fn(0), training))
+            lambda: self._stage_fn(slab, pre_fn(0), training)[0])
         zero = jnp.zeros(h0_aval.shape, h0_aval.dtype)
         out_buf = jnp.zeros((M,) + h0_aval.shape, h0_aval.dtype)
 
         def tick(carry, t):
-            act, out_buf = carry
-            y = self._stage_fn(slab, act, training)
+            act, out_buf, aux_acc = carry
+            y, aux_t = self._stage_fn(slab, act, training)
+            # this rank's tick t holds microbatch (t - idx); bubble ticks
+            # run on zeros and their router aux must not count
+            valid = (t >= idx) & (t < idx + M)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
             out_idx = t - (S - 1)
             write = (idx == S - 1) & (out_idx >= 0)
             slot = jnp.clip(out_idx, 0, M - 1)
@@ -206,30 +227,37 @@ class GPipeTrainer:
                             micro_in, jnp.clip(t + 1, 0, M - 1), 0,
                             keepdims=False)), training=training)
             act = jnp.where(idx == 0, inj, y_next)
-            return (act, out_buf), None
+            return (act, out_buf, aux_acc), None
 
         # t counts processed ticks: act entering tick t is stage input
         # for microbatch (t - stage); total M + S - 1 ticks
         init_act = jnp.where(idx == 0, pre_fn(0), zero)
-        (act, out_buf), _ = jax.lax.scan(
-            tick, (init_act, out_buf), jnp.arange(M + S - 1))
+        (act, out_buf, aux_acc), _ = jax.lax.scan(
+            tick, (init_act, out_buf, jnp.float32(0.0)),
+            jnp.arange(M + S - 1))
 
         # head + loss on every rank; only the last pp rank's is real
+        from .moe import collect_aux_losses
         losses = []
-        for m in range(M):
-            out = _call(self.post, post_p, Tensor(out_buf[m]),
-                        training=training)
-            out_t = jax.tree_util.tree_map(
-                lambda a: Tensor(a, stop_gradient=True), out)
-            lab = jax.tree_util.tree_map(
-                lambda a: Tensor(a[m]), micro_lab)
-            lab = lab if isinstance(lab, (list, tuple)) else (lab,)
-            l = self.loss_fn(out_t, *lab)
-            losses.append((l.data if isinstance(l, Tensor) else l)
-                          .astype(jnp.float32))
+        with collect_aux_losses() as post_aux:
+            for m in range(M):
+                out = _call(self.post, post_p, Tensor(out_buf[m]),
+                            training=training)
+                out_t = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), out)
+                lab = jax.tree_util.tree_map(
+                    lambda a: Tensor(a[m]), micro_lab)
+                lab = lab if isinstance(lab, (list, tuple)) else (lab,)
+                l = self.loss_fn(out_t, *lab)
+                losses.append((l.data if isinstance(l, Tensor) else l)
+                              .astype(jnp.float32))
         local = jnp.stack(losses).mean()
+        for a in post_aux:
+            arr = (a.data if isinstance(a, Tensor) else a)
+            local = local + arr.astype(jnp.float32) / M
         masked = jnp.where(idx == S - 1, local, 0.0)
-        return masked / self.dp_size
+        # block aux: each rank saw every microbatch once -> mean over M
+        return (masked + aux_acc / M) / self.dp_size
 
     def _build(self, training=True):
         mesh = self.mesh

@@ -57,6 +57,8 @@ def recompute(function, *args, policy=None, **kwargs):
     with the region's OUTPUT values, so no inner-trace tracer ever leaks
     into live module state.
     """
+    from .moe import add_aux_loss, collect_aux_losses
+
     if isinstance(function, Layer):
         param_objs = [p for _, p in function.named_parameters()]
         buf_objs = [b for _, b in function.named_buffers()
@@ -79,7 +81,14 @@ def recompute(function, *args, policy=None, **kwargs):
         try:
             wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
                        for a in in_arrs]
-            out = function(*wrapped, **kwargs)
+            # aux losses (MoE routers) produced inside the remat region
+            # are tracers of the INNER checkpoint trace; they must leave
+            # the region as explicit outputs, then be re-emitted outside
+            # (otherwise adding them to the loss later leaks the tracer)
+            with collect_aux_losses() as aux:
+                out = function(*wrapped, **kwargs)
+            aux_arrs = tuple(a.data if isinstance(a, Tensor) else a
+                             for a in aux)
             new_bufs = tuple(b._data for b in buf_objs)
         finally:
             for o, a in zip(param_objs, orig_p):
@@ -92,14 +101,16 @@ def recompute(function, *args, policy=None, **kwargs):
         leaves, treedef = jax.tree_util.tree_flatten(out_arrs)
         meta["treedef"] = treedef
         meta["n_out"] = len(leaves)
-        return tuple(leaves) + new_bufs
+        return tuple(leaves) + new_bufs + aux_arrs
 
     ckpt = jax.checkpoint(pure, policy=checkpoint_policy(policy))
     res = apply(ckpt, *param_objs, *buf_objs, *args, name="recompute")
     res = res if isinstance(res, tuple) else (res,)
     out_leaves = list(res[:meta["n_out"]])
-    for b, nv in zip(buf_objs, res[meta["n_out"]:]):
+    for b, nv in zip(buf_objs, res[meta["n_out"]:meta["n_out"] + n_bufs]):
         b._data = nv.data
+    for a in res[meta["n_out"] + n_bufs:]:
+        add_aux_loss(a)
     out = jax.tree_util.tree_unflatten(meta["treedef"], out_leaves)
     return out
 

@@ -37,7 +37,8 @@ from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
 from .fleet.strategy import DistributedStrategy
-from .mesh import Mesh, NamedSharding, PartitionSpec, default_mesh
+from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
+                   mesh_guard)
 
 __all__ = ["SpmdTrainer", "dp_train_step", "zero_sharding_spec",
            "build_param_specs"]
@@ -272,7 +273,8 @@ class SpmdTrainer:
                 else a for a in inputs)
         # the eager tape is bypassed during tracing (jax.grad differentiates
         # the traced ops; recording GradNodes here would only slow compiles)
-        with no_grad():
+        from .moe import collect_aux_losses
+        with no_grad(), collect_aux_losses() as aux:
             out, new_buffers = functional_call(
                 self.model, params, buffers, *inputs, training=True)
         out_t = jax.tree_util.tree_map(
@@ -281,6 +283,9 @@ class SpmdTrainer:
                    for l in labels]
         loss = self.loss_fn(out_t, *label_t)
         loss_arr = loss.data if isinstance(loss, Tensor) else loss
+        # router load-balance losses (MoE) ride on top of the task loss
+        for a in aux:
+            loss_arr = loss_arr + (a.data if isinstance(a, Tensor) else a)
         return loss_arr.astype(jnp.float32), new_buffers
 
     def _grads_fn(self, params, buffers, inputs, labels):
@@ -395,10 +400,13 @@ class SpmdTrainer:
                 self._compiled[key] = self._build_fused(
                     len(inputs), len(labels))
             step_no = jnp.asarray(self._step_count + 1, jnp.int32)
-            (self.params, self.opt_state, self.buffers,
-             loss) = self._compiled[key](
-                self.params, self.opt_state, self.buffers, lr, step_no,
-                *batch)
+            # the ambient mesh lets layers place sharding constraints on
+            # intermediates (MoE dispatch buffers) while jit traces
+            with mesh_guard(self.mesh):
+                (self.params, self.opt_state, self.buffers,
+                 loss) = self._compiled[key](
+                    self.params, self.opt_state, self.buffers, lr, step_no,
+                    *batch)
             self._step_count += 1
             self.optimizer._step_count = self._step_count
             return loss
@@ -409,8 +417,9 @@ class SpmdTrainer:
                 len(inputs), len(labels))
         if "update" not in self._compiled:
             self._compiled["update"] = self._build_update()
-        self._grad_buf, self.buffers, loss = self._compiled[akey](
-            self.params, self._grad_buf, self.buffers, *batch)
+        with mesh_guard(self.mesh):
+            self._grad_buf, self.buffers, loss = self._compiled[akey](
+                self.params, self._grad_buf, self.buffers, *batch)
         self._step_count += 1
         if self._step_count % self.k_steps == 0:
             step_no = jnp.asarray(
@@ -428,7 +437,8 @@ class SpmdTrainer:
         key = ("eval", len(inputs))
         if key not in self._compiled:
             self._compiled[key] = self._build_eval(len(inputs))
-        return self._compiled[key](self.params, self.buffers, *batch)
+        with mesh_guard(self.mesh):
+            return self._compiled[key](self.params, self.buffers, *batch)
 
     predict_step = eval_step
 

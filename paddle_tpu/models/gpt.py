@@ -64,12 +64,24 @@ class GPTConfig:
     use_flash_attention: bool = True
     tie_word_embeddings: bool = True
     tp_axis: str = "tp"
+    # MoE (0 experts = dense; BASELINE.json config #5 switch-transformer)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_every_n_layers: int = 1   # every Nth block is MoE
+    moe_aux_loss_coeff: float = 0.01
+    moe_z_loss_coeff: float = 0.0
+    ep_axis: str = "ep"
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
+
+    def is_moe_layer(self, layer_idx: int) -> bool:
+        return (self.moe_num_experts > 0 and
+                (layer_idx + 1) % self.moe_every_n_layers == 0)
 
     @property
     def head_dim(self):
@@ -199,16 +211,35 @@ class GPTMLP(Layer):
 
 
 class GPTBlock(Layer):
-    """Pre-LN decoder block (GPT-2/3 style)."""
+    """Pre-LN decoder block (GPT-2/3 style). When the config marks this
+    layer index as MoE the dense MLP is replaced by an expert-parallel
+    MoELayer (switch-transformer block; BASELINE.json config #5)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        if config.is_moe_layer(layer_idx):
+            from ..distributed.moe import MoELayer
+            self.mlp = MoELayer(
+                config.hidden_size, config.ffn_hidden_size,
+                num_experts=config.moe_num_experts,
+                top_k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+                aux_loss_coeff=config.moe_aux_loss_coeff,
+                z_loss_coeff=config.moe_z_loss_coeff,
+                ep_axis=config.ep_axis,
+                weight_attr=ParamAttr(initializer=I.Normal(
+                    0.0, config.initializer_range)),
+                # depth-scaled residual-out init, same as GPTMLP.down_proj
+                down_weight_attr=ParamAttr(initializer=I.Normal(
+                    0.0, config.initializer_range / math.sqrt(
+                        2.0 * config.num_layers))))
+        else:
+            self.mlp = GPTMLP(config)
 
     def forward(self, x, attn_mask=None):
         x = x + self.attn(self.ln_1(x), attn_mask=attn_mask)
@@ -231,8 +262,8 @@ class GPTModel(Layer):
                              weight_attr=ParamAttr(initializer=I.Normal(
                                  0.0, config.initializer_range)))
         self.drop = Dropout(config.dropout)
-        self.blocks = LayerList([GPTBlock(config)
-                                 for _ in range(config.num_layers)])
+        self.blocks = LayerList([GPTBlock(config, layer_idx=i)
+                                 for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
         self._recompute = False

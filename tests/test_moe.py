@@ -1,0 +1,336 @@
+"""MoE / expert parallelism tests (virtual 8-device CPU mesh).
+
+The reference snapshot has no MoE (SURVEY.md §2.5: "ABSENT — design
+fresh"), so the ground truth here is an independent per-token numpy
+reference, and the parity contract is: dense single-device == GSPMD
+expert-parallel == explicit shard_map all_to_all formulation.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import SpmdTrainer, create_mesh
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.mesh import (NamedSharding, PartitionSpec,
+                                         mesh_guard)
+from paddle_tpu.distributed.moe import (MoELayer, collect_aux_losses,
+                                        moe_capacity, top_k_gating)
+
+
+def softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def moe_reference(x, gate_w, w_up, b_up, w_down, b_down, top_k, capacity,
+                  normalize=True):
+    """Independent per-token loop implementation of Switch/GShard routing
+    (sequential greedy capacity assignment, gelu FFN experts)."""
+    B, S, H = x.shape
+    E = gate_w.shape[1]
+    y = np.zeros_like(x)
+    for b in range(B):
+        fill = np.zeros(E, dtype=int)
+        # choices per token (top-k by prob, chosen greedily in seq order)
+        probs = softmax(x[b] @ gate_w)         # [S, E]
+        order = np.argsort(-probs, axis=-1)[:, :top_k]  # [S, k]
+        gates = np.take_along_axis(probs, order, axis=-1)
+        if normalize and top_k > 1:
+            gates = gates / (gates.sum(-1, keepdims=True) + 1e-9)
+        # capacity filled in choice-major order (all 1st choices, then
+        # 2nd choices), matching the layer's per-choice cumsum
+        keep = np.zeros((S, top_k), dtype=bool)
+        for kk in range(top_k):
+            for s in range(S):
+                e = order[s, kk]
+                if fill[e] < capacity:
+                    keep[s, kk] = True
+                    fill[e] += 1
+        for s in range(S):
+            for kk in range(top_k):
+                if not keep[s, kk]:
+                    continue
+                e = order[s, kk]
+                h1 = x[b, s] @ w_up[e] + b_up[e]
+                h1 = 0.5 * h1 * (1 + np.tanh(
+                    np.sqrt(2 / np.pi) * (h1 + 0.044715 * h1 ** 3)))
+                y[b, s] += gates[s, kk] * (h1 @ w_down[e] + b_down[e])
+    return y
+
+
+def make_layer(E=4, H=8, F=16, top_k=2, cf=8.0, seed=0):
+    paddle.seed(seed)
+    return MoELayer(H, F, num_experts=E, top_k=top_k, capacity_factor=cf,
+                    aux_loss_coeff=0.01)
+
+
+def test_gating_shapes_and_capacity():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(2, 16, 4).astype(np.float32))
+    cap = 3
+    dispatch, combine, aux, zloss = top_k_gating(logits, 2, cap)
+    assert dispatch.shape == (2, 16, 4, cap)
+    # every capacity slot used at most once per expert
+    per_slot = np.asarray(dispatch).sum(axis=1)       # [B, E, C]
+    assert per_slot.max() <= 1.0 + 1e-6
+    # each token dispatched to at most top_k slots
+    per_tok = np.asarray(dispatch).sum(axis=(2, 3))   # [B, S]
+    assert per_tok.max() <= 2 + 1e-6
+    # combine weights of surviving tokens sum to ~1 (normalized)
+    surv = per_tok == 2
+    csum = np.asarray(combine).sum(axis=(2, 3))
+    np.testing.assert_allclose(csum[surv], 1.0, rtol=1e-5)
+    assert float(aux) > 0 and float(zloss) > 0
+
+
+def test_moe_matches_loop_reference():
+    layer = make_layer(E=4, H=8, F=16, top_k=2, cf=8.0)
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 12, 8).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    cap = moe_capacity(12, 4, 2, 8.0)
+    ref = moe_reference(
+        x, np.asarray(layer.gate.data),
+        np.asarray(layer.experts.w_up.data),
+        np.asarray(layer.experts.b_up.data),
+        np.asarray(layer.experts.w_down.data),
+        np.asarray(layer.experts.b_down.data), 2, cap)
+    np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_moe_drops_tokens_at_low_capacity():
+    """cf small => some tokens overflow; their output is 0 (residual
+    carries them in a transformer block)."""
+    layer = make_layer(E=4, H=8, F=16, top_k=1, cf=0.3)
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 16, 8).astype(np.float32)
+    out = np.asarray(layer(paddle.to_tensor(x)).data)
+    dropped = np.all(out == 0.0, axis=-1)
+    assert dropped.sum() > 0
+
+
+def test_shard_map_all_to_all_matches_dense():
+    """Explicit lax.all_to_all formulation over an 8-device 'ep' axis
+    reproduces the dense single-device layer bit-for-bit (dp==ep: tokens
+    sharded on batch, experts sharded on E)."""
+    E, H, Fd = 8, 8, 16
+    layer = make_layer(E=E, H=H, F=Fd, top_k=2, cf=8.0)
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 6, H).astype(np.float32)
+    dense_out = np.asarray(layer(paddle.to_tensor(x)).data)
+
+    mesh = create_mesh({"ep": 8})
+    from jax import shard_map
+
+    gate = layer.gate.data
+    wu, bu = layer.experts.w_up.data, layer.experts.b_up.data
+    wd, bd = layer.experts.w_down.data, layer.experts.b_down.data
+
+    def fn(xs, gate, wu, bu, wd, bd):
+        y, aux, zl = layer._fn_shard_map(xs, gate, wu, bu, wd, bd)
+        return y
+
+    P = PartitionSpec
+    smapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+        out_specs=P("ep"))
+    out = np.asarray(jax.jit(smapped)(jnp.asarray(x), gate, wu, bu,
+                                      wd, bd))
+    np.testing.assert_allclose(out, dense_out, rtol=1e-4, atol=1e-5)
+
+
+def test_aux_loss_collected_and_differentiable():
+    layer = make_layer()
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(2, 8, 8).astype(np.float32))
+    with collect_aux_losses() as aux:
+        out = layer(x)
+    assert len(aux) == 1 and float(aux[0].data) > 0
+    # aux loss backprops into the gate
+    total = out.sum() + aux[0]
+    total.backward()
+    assert layer.gate.grad is not None
+    assert np.any(np.asarray(layer.gate.grad.data) != 0)
+
+
+def _moe_gpt(seed=0, ep_experts=4):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=16, use_flash_attention=False,
+                    moe_num_experts=ep_experts, moe_top_k=2,
+                    moe_capacity_factor=4.0, moe_every_n_layers=2)
+    return cfg, GPTForCausalLM(cfg)
+
+
+def test_gpt_moe_spmd_trainer_parity():
+    """GPT-MoE under SpmdTrainer: dp2 x ep4 mesh loss matches the
+    single-device run step by step (the expert-parallel layout changes
+    placement, not math)."""
+    from paddle_tpu.models import GPTPretrainingCriterion
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(3):
+        ids = rng.randint(0, 64, (4, 16)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1).astype(np.int64)
+        batches.append((ids, labels))
+
+    losses = {}
+    for name, mesh_axes in [("single", {"dp": 1}),
+                            ("ep", {"dp": 2, "ep": 4})]:
+        cfg, model = _moe_gpt(seed=7)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        mesh = create_mesh(mesh_axes)
+        tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh)
+        losses[name] = [float(tr.train_step(x, y)) for x, y in batches]
+        # expert weights actually sharded over ep
+        if name == "ep":
+            wu = tr.params["gpt.blocks.1.mlp.experts.w_up"]
+            assert "ep" in str(wu.sharding.spec)
+    np.testing.assert_allclose(losses["ep"], losses["single"], rtol=2e-4,
+                               atol=2e-5)
+    # training moves the loss
+    assert losses["ep"][-1] != losses["ep"][0]
+
+
+def test_gpt_moe_aux_loss_in_compiled_trainer():
+    """The compiled trainer adds router aux losses: a trainer whose
+    criterion is constant-zero still produces a positive loss (the aux
+    term), proving collection inside the traced step."""
+    cfg, model = _moe_gpt(seed=1)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=model.parameters())
+    mesh = create_mesh({"dp": 1})
+    zero = lambda o, l: (o.sum() * 0.0)
+    tr = SpmdTrainer(model, opt, zero, mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2, 16)).astype(np.int32)
+    loss = float(tr.train_step(ids, ids.astype(np.int64)))
+    assert loss > 0.0
+
+
+def test_gpt_moe_with_recompute():
+    """Review regression: MoE + activation recompute (aux losses must
+    leave the jax.checkpoint region as explicit outputs, not leak as
+    tracers through the collector)."""
+    from paddle_tpu.models import GPTPretrainingCriterion
+    cfg, model = _moe_gpt(seed=3)
+    model.enable_recompute()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    crit = GPTPretrainingCriterion()
+    st = DistributedStrategy()
+    st.recompute = True
+    tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l),
+                     mesh=create_mesh({"dp": 1}), strategy=st)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2, 16)).astype(np.int32)
+    l0 = float(tr.train_step(ids, ids.astype(np.int64)))
+    l1 = float(tr.train_step(ids, ids.astype(np.int64)))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_gpt_moe_pipeline_aux_flows():
+    """MoE blocks under GPipeTrainer: the router aux loss reaches the
+    training loss (gate weights receive gradient and move)."""
+    from paddle_tpu.distributed.pipeline import GPipeTrainer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.models.gpt import gpt_pipeline_parts
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=16, use_flash_attention=False,
+                    tie_word_embeddings=False, moe_num_experts=4,
+                    moe_top_k=2, moe_capacity_factor=4.0,
+                    moe_aux_loss_coeff=0.05)
+    model = GPTForCausalLM(cfg)
+    pre, blocks, post = gpt_pipeline_parts(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    crit = GPTPretrainingCriterion()
+    mesh = create_mesh({"dp": 2, "pp": 2})
+    pipe = GPipeTrainer(pre, blocks, post, opt, lambda o, l: crit(o, l),
+                        mesh=mesh, num_microbatches=2, remat=True)
+    gate_key = [k for k in pipe.params["blocks"] if "gate" in k][0]
+    g0 = np.asarray(pipe.params["blocks"][gate_key]).copy()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    loss = float(pipe.train_step(ids, np.roll(ids, -1, 1).astype(np.int64)))
+    assert np.isfinite(loss)
+    g1 = np.asarray(pipe.params["blocks"][gate_key])
+    assert np.any(g0 != g1), "router gate got no gradient under pipeline"
+
+
+def test_gpt_moe_pipeline_loss_includes_aux():
+    """Pipeline loss parity with SpmdTrainer for an MoE model on the
+    FIRST step (same params, same batch): both must include the router
+    aux term."""
+    from paddle_tpu.distributed.pipeline import GPipeTrainer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.models.gpt import gpt_pipeline_parts
+    crit = GPTPretrainingCriterion()
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=16, use_flash_attention=False,
+              tie_word_embeddings=False, moe_num_experts=4, moe_top_k=2,
+              moe_capacity_factor=8.0, moe_aux_loss_coeff=0.05)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+
+    paddle.seed(21)
+    m1 = GPTForCausalLM(GPTConfig(**kw))
+    tr = SpmdTrainer(m1, paddle.optimizer.SGD(
+        learning_rate=0.0, parameters=m1.parameters()),
+        lambda o, l: crit(o, l), mesh=create_mesh({"dp": 1}))
+    ref = float(tr.train_step(ids, labels))
+
+    paddle.seed(21)
+    m2 = GPTForCausalLM(GPTConfig(**kw))
+    pre, blocks, post = gpt_pipeline_parts(m2)
+    pipe = GPipeTrainer(pre, blocks, post, paddle.optimizer.SGD(
+        learning_rate=0.0, parameters=m2.parameters()),
+        lambda o, l: crit(o, l), mesh=create_mesh({"pp": 2}),
+        num_microbatches=2, remat=False)
+    got = float(pipe.train_step(ids, labels))
+    np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+def test_moe_trainer_ignores_stale_global_mesh():
+    """Review regression: a process-global mesh left over from earlier
+    code (default_mesh/dp_train_step) must not leak wrong-mesh sharding
+    constraints into an MoE trainer built on its own explicit mesh."""
+    from paddle_tpu.distributed.mesh import set_mesh
+    from paddle_tpu.models import GPTPretrainingCriterion
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+
+    cfg, model = _moe_gpt(seed=9)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    ref_cfg, ref_model = _moe_gpt(seed=9)
+    ref_opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=ref_model.parameters())
+    ref_tr = SpmdTrainer(ref_model, ref_opt, lambda o, l: crit(o, l),
+                         mesh=create_mesh({"dp": 1}))
+    ref = [float(ref_tr.train_step(ids, labels)) for _ in range(2)]
+
+    stale = create_mesh({"dp": 8})
+    set_mesh(stale)
+    try:
+        tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l),
+                         mesh=create_mesh({"dp": 2, "ep": 4}))
+        got = [float(tr.train_step(ids, labels)) for _ in range(2)]
+    finally:
+        set_mesh(None)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
