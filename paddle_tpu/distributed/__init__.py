@@ -24,3 +24,6 @@ from .recompute import recompute, RecomputeWrapper  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import (  # noqa: F401
     MoELayer, ExpertParallelFFN, collect_aux_losses, add_aux_loss)
+from . import ring_attention as ring_attention_mod  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_local, sequence_parallel_attention)

@@ -1,0 +1,156 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+ABSENT in the reference snapshot (SURVEY.md §5: "no ring-attention /
+Ulysses / context-parallel code — the TPU framework must design sequence
+parallelism fresh, as a first-class parallel axis of the mesh"). The only
+long-sequence tools the reference has are LoD variable-length batching and
+recompute; this module adds the real thing.
+
+Design (blockwise/ring attention, Liu et al. 2023, written for ICI):
+- the sequence dimension of q/k/v is sharded over the 'sp' mesh axis;
+  each device holds a contiguous block of T = S/sp positions;
+- attention runs as sp rounds of blockwise softmax: every round each
+  device attends its local queries against the K/V block it currently
+  holds, then rotates the K/V block to its ring neighbor with
+  ``lax.ppermute`` (XLA overlaps the ICI transfer with the next round's
+  compute) while accumulating output in online-softmax form (running
+  max m, normalizer l, unnormalized output o — the same recurrence the
+  Pallas flash kernel uses within a chip);
+- causal masking is positional: device r's queries at global positions
+  r*T+i mask K/V positions by origin block, so late rounds on early
+  ranks contribute nothing but keep the program SPMD-uniform;
+- backward is jax.grad through the scan+ppermute (the transpose of a
+  ppermute is the reverse-direction ppermute, so the ring runs backward
+  in the backward pass automatically — no hand-written comm schedule).
+
+Composes with 'dp' (batch dim) and 'tp' (heads) in one mesh: the
+shard_map covers only the attention op; everything around it stays in
+GSPMD-sharded pjit.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from .mesh import Mesh, PartitionSpec, get_mesh
+
+__all__ = ["ring_attention", "ring_attention_local",
+           "sequence_parallel_attention"]
+
+_NEG = jnp.float32(-1e30)
+
+
+def ring_attention_local(q, k, v, axis_name: str = "sp",
+                         causal: bool = True, scale: Optional[float] = None):
+    """The per-device program (call inside shard_map with `axis_name`
+    bound). q: local shard [B, T, H, D] where T = S/sp; k/v may carry
+    fewer heads [B, T, Hkv, D] (GQA) — the UN-expanded blocks are what
+    rotate, so grouped-query models pay Hkv/H of the MHA ring traffic.
+    Returns the local output shard [B, T, H, D]."""
+    sp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    g = h // hkv                               # q heads per kv head
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B,T,H,D] -> [B,Hkv,G,T,D]; kv head j serves q heads [j*g,(j+1)*g)
+    qf = jnp.swapaxes(q.astype(jnp.float32), 1, 2) \
+        .reshape(b, hkv, g, t, d) * sc
+    q_pos = rank * t + jnp.arange(t)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def block(o, m, l, k_cur, v_cur, i):
+        src = (rank - i) % sp                  # origin block of k_cur
+        kf = jnp.swapaxes(k_cur.astype(jnp.float32), 1, 2)  # [B,Hkv,T,D]
+        vf = jnp.swapaxes(v_cur.astype(jnp.float32), 1, 2)
+        s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf)
+        if causal:
+            k_pos = src * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]        # [T,T]
+            s = jnp.where(mask[None, None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))             # [B,Hkv,G,T]
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            # rows that are fully masked would otherwise exp(NEG-NEG)=1
+            p = p * mask[None, None, None]
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhgqk,bhkd->bhgqd", p, vf)
+        return o, m_new, l
+
+    def round_(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # compute reads k_cur, the permute also reads k_cur: XLA overlaps
+        # the neighbor exchange with this round's matmuls
+        o, m, l = block(o, m, l, k_cur, v_cur, i)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_cur, v_cur), None
+
+    o0 = jnp.zeros((b, hkv, g, t, d), jnp.float32)
+    m0 = jnp.full((b, hkv, g, t), _NEG)
+    l0 = jnp.zeros((b, hkv, g, t), jnp.float32)
+    carry = (o0, m0, l0, k, v)
+    if sp > 1:
+        # sp-1 rotated rounds in the scan; the final round runs outside
+        # so the last (discarded) rotation is never issued
+        carry, _ = jax.lax.scan(round_, carry, jnp.arange(sp - 1))
+    o, m, l, k_last, v_last = carry
+    o, m, l = block(o, m, l, k_last, v_last, sp - 1)
+    out = o / jnp.maximum(l, 1e-30)[..., None]             # [B,Hkv,G,T,D]
+    out = out.reshape(b, h, t, d)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)         # [B,T,H,D]
+
+
+def ring_attention(q, k, v, mesh: Optional[Mesh] = None,
+                   sp_axis: str = "sp", batch_axis: Optional[str] = "dp",
+                   causal: bool = True, scale: Optional[float] = None):
+    """Global-array entry point: shard the seq dim of q/k/v [B, S, H, D]
+    over `sp_axis` and run the ring. Works inside a pjit/GSPMD trace (the
+    compiled trainers) and eagerly on raw arrays; `mesh` defaults to the
+    ambient mesh the trainer binds while tracing."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention needs a mesh (pass mesh= or set "
+                         "one with paddle_tpu.distributed.set_mesh)")
+    ba = batch_axis if (batch_axis in mesh.axis_names and
+                        mesh.shape[batch_axis] > 1) else None
+    sp = mesh.shape[sp_axis] if sp_axis in mesh.axis_names else 1
+    if q.shape[1] % max(sp, 1):
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by {sp_axis}="
+            f"{sp}; pad the sequence or drop to dense attention")
+    if ba is not None and q.shape[0] % mesh.shape[ba]:
+        raise ValueError(
+            f"batch {q.shape[0]} not divisible by {ba}="
+            f"{mesh.shape[ba]}; use batch_axis=None or pad the batch")
+    spec = PartitionSpec(ba, sp_axis, None, None)
+    fn = partial(ring_attention_local, axis_name=sp_axis, causal=causal,
+                 scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def sequence_parallel_attention(query, key, value, mesh=None,
+                                sp_axis: str = "sp", batch_axis="dp",
+                                causal: bool = True, scale=None):
+    """Tensor-level API (autograd-recorded): drop-in replacement for
+    scaled_dot_product_attention when the sequence dim is sharded over
+    'sp'. Inputs [B, S, H, D] with equal q/k/v sequence lengths."""
+    m = mesh or get_mesh()
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, mesh=m, sp_axis=sp_axis,
+                              batch_axis=batch_axis, causal=causal,
+                              scale=scale)
+
+    return apply(fn, query, key, value, name="ring_attention")

@@ -243,11 +243,18 @@ class SpmdTrainer:
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, arr):
-        spec = PartitionSpec(
-            self.dp_axis if (self.dp_size > 1 and arr.ndim > 0 and
-                             arr.shape[0] % self.dp_size == 0) else None,
-            *([None] * max(0, arr.ndim - 1)))
-        return NamedSharding(self.mesh, spec)
+        dims = [self.dp_axis if (self.dp_size > 1 and arr.ndim > 0 and
+                                 arr.shape[0] % self.dp_size == 0)
+                else None]
+        # sequence/context parallelism: dim 1 shards over 'sp' (ring
+        # attention consumes the blocks; everything else is GSPMD-local)
+        sp_size = self.mesh.shape.get("sp", 1) \
+            if "sp" in self.mesh.axis_names else 1
+        if arr.ndim > 1:
+            dims.append("sp" if (sp_size > 1 and
+                                 arr.shape[1] % sp_size == 0) else None)
+        dims += [None] * max(0, arr.ndim - len(dims))
+        return NamedSharding(self.mesh, PartitionSpec(*dims))
 
     def shard_batch(self, batch):
         """Host batch -> device arrays sharded over 'dp' on dim 0 (the

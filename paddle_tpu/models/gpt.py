@@ -72,6 +72,9 @@ class GPTConfig:
     moe_aux_loss_coeff: float = 0.01
     moe_z_loss_coeff: float = 0.0
     ep_axis: str = "ep"
+    # sequence/context parallelism: ring attention over the 'sp' axis
+    sequence_parallel: bool = False
+    sp_axis: str = "sp"
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -142,6 +145,21 @@ class GPTAttention(Layer):
             axis_name=config.tp_axis)
         self.dropout = Dropout(config.dropout)
 
+    def _sp_active(self, b, s) -> bool:
+        """True when an ambient mesh (bound by the compiled trainer while
+        tracing) carries a real 'sp' axis AND the shapes divide evenly —
+        ragged batches fall back to dense attention instead of crashing
+        the shard_map."""
+        from ..distributed.mesh import get_mesh
+        m = get_mesh()
+        if (m is None or self.cfg.sp_axis not in m.axis_names or
+                m.shape[self.cfg.sp_axis] <= 1):
+            return False
+        if s % m.shape[self.cfg.sp_axis]:
+            return False
+        dp = m.shape.get("dp", 1) if "dp" in m.axis_names else 1
+        return b % dp == 0
+
     def forward(self, x, attn_mask=None, cache=None):
         cfg = self.cfg
         b = x.shape[0]
@@ -164,16 +182,33 @@ class GPTAttention(Layer):
             v = concat([pv, v], axis=1) if pv is not None else v
             new_cache = (k, v)
 
-        if cfg.num_kv_heads != cfg.num_heads:
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = repeat_interleave(k, rep, axis=2)
-            v = repeat_interleave(v, rep, axis=2)
-
         # Any multi-token call is causal — including prefill with a cache
         # (the composite's bottom-right-aligned mask lets query i see keys
         # <= past + i). Only single-token decode attends unmasked.
         causal = s > 1
         empty_cache = cache is None or cache[0] is None
+        if (cfg.sequence_parallel and attn_mask is None and empty_cache
+                and self._sp_active(b, s)):
+            # ring attention: seq dim sharded over 'sp', KV blocks rotate
+            # around the ICI ring (distributed/ring_attention.py). K/V go
+            # in UN-expanded (GQA): the ring rotates Hkv heads, not H.
+            from ..distributed.ring_attention import \
+                sequence_parallel_attention
+            if cfg.attn_dropout:
+                raise NotImplementedError(
+                    "attn_dropout inside ring attention is not supported")
+            out = sequence_parallel_attention(
+                q, k, v, sp_axis=cfg.sp_axis, causal=causal)
+            out = out.reshape([b, s, -1])
+            out = self.out_proj(out)
+            out = self.dropout(out)
+            return (out, new_cache) if cache is not None else out
+
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+
         if cfg.use_flash_attention and attn_mask is None and empty_cache:
             out = F.flash_attention(q, k, v, dropout=cfg.attn_dropout,
                                     causal=causal,
