@@ -17,6 +17,6 @@ from .base import (  # noqa: F401
     worker_endpoints, server_num, server_index, server_endpoints,
     is_server, barrier_worker, init_worker, init_server, run_server,
     stop_worker, distributed_optimizer, DistributedOptimizer,
-    save_persistables, save_inference_model, minimize)
+    distributed_model, save_persistables, save_inference_model, minimize)
 from .strategy import DistributedStrategy  # noqa: F401
 from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401

@@ -193,5 +193,23 @@ def distributed_optimizer(optimizer, strategy=None):
     return DistributedOptimizer(optimizer, strategy)
 
 
+def distributed_model(model, optimizer, loss_fn, mesh=None):
+    """Build the compiled trainer from a fleet-configured optimizer — the
+    TPU-native endpoint of the reference's fleet.minimize meta-optimizer
+    chain (fleet_base.py:1066: strategy -> program rewrite ->
+    ParallelExecutor). Here: strategy -> SpmdTrainer (or GPipeTrainer for
+    strategy.pipeline via distributed.pipeline) as ONE XLA executable.
+
+    Returns an SpmdTrainer; drive it with trainer.train_step(x, y).
+    """
+    from ..mesh import default_mesh
+    from ..spmd import SpmdTrainer
+    strategy = getattr(optimizer, "user_defined_strategy", None) or \
+        _user_strategy
+    inner = getattr(optimizer, "inner_opt", optimizer)
+    return SpmdTrainer(model, inner, loss_fn,
+                       mesh=mesh or default_mesh(), strategy=strategy)
+
+
 def minimize(loss, **kwargs):
     raise RuntimeError("call fleet.distributed_optimizer(...).minimize")

@@ -125,6 +125,15 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None,
     ba = batch_axis if (batch_axis in mesh.axis_names and
                         mesh.shape[batch_axis] > 1) else None
     sp = mesh.shape[sp_axis] if sp_axis in mesh.axis_names else 1
+    if sp <= 1:
+        # no real sp axis: ring degenerates to plain attention (GQA k/v
+        # expanded here; the composite needs full heads)
+        from ..nn.functional.attention import _sdpa_reference
+        h, hkv = q.shape[2], k.shape[2]
+        if h != hkv:
+            k = jnp.repeat(k, h // hkv, axis=2)
+            v = jnp.repeat(v, h // hkv, axis=2)
+        return _sdpa_reference(q, k, v, is_causal=causal, scale=scale)
     if q.shape[1] % max(sp, 1):
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by {sp_axis}="

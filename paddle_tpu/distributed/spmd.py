@@ -293,9 +293,10 @@ class SpmdTrainer:
         # router load-balance losses (MoE) ride on top of the task loss
         for a in aux:
             loss_arr = loss_arr + (a.data if isinstance(a, Tensor) else a)
-        return loss_arr.astype(jnp.float32), new_buffers
+        return loss_arr.astype(jnp.float32), (new_buffers, out)
 
-    def _grads_fn(self, params, buffers, inputs, labels):
+    def _grads_fn(self, params, buffers, inputs, labels,
+                  want_outputs=False):
         """value_and_grad over trainable params only; frozen params flow
         as constants."""
         train_p = {n: a for n, a in params.items() if self._trainable[n]}
@@ -306,11 +307,11 @@ class SpmdTrainer:
             return self._loss_and_buffers({**tp, **frozen_p}, buffers,
                                           inputs, labels)
 
-        (loss, new_buffers), grads = jax.value_and_grad(
+        (loss, (new_buffers, outs)), grads = jax.value_and_grad(
             lfn, has_aux=True)(train_p)
         grads = {n: grads.get(n, jnp.zeros_like(a))
                  for n, a in params.items()}
-        return loss, new_buffers, grads
+        return loss, new_buffers, grads, (outs if want_outputs else None)
 
     def _apply(self, params, opt_state, grads, lr, step_no):
         new_train, new_state = self.optimizer.apply_gradients(
@@ -323,31 +324,36 @@ class SpmdTrainer:
         return new_params, new_opt
 
     # ------------------------------------------------------------------
-    def _build_fused(self, n_inputs, n_labels):
-        """Single-executable step: fwd+bwd+update (k_steps == 1)."""
+    def _build_fused(self, n_inputs, n_labels, with_outputs=False):
+        """Single-executable step: fwd+bwd+update (k_steps == 1).
+        with_outputs additionally returns the forward outputs (hapi needs
+        them for metrics; XLA computes them anyway)."""
         def step(params, opt_state, buffers, lr, step_no, *batch):
             inputs, labels = batch[:n_inputs], batch[n_inputs:]
-            loss, new_buffers, grads = self._grads_fn(
-                params, buffers, inputs, labels)
+            loss, new_buffers, grads, outs = self._grads_fn(
+                params, buffers, inputs, labels, want_outputs=with_outputs)
             new_params, new_opt = self._apply(
                 params, opt_state, grads, lr, step_no)
             merged = dict(buffers)
             merged.update(new_buffers)
+            if with_outputs:
+                return new_params, new_opt, merged, loss, outs
             return new_params, new_opt, merged, loss
 
         donate = (0, 1, 2) if self._donate else ()
         # input shardings come from the committed input arrays (device_put
         # in __init__/shard_batch); out_shardings pin the state placement
-        return jax.jit(
-            step,
-            out_shardings=(self._param_shardings, self._opt_shardings,
-                           self._buffer_shardings, self._repl),
-            donate_argnums=donate)
+        shardings = (self._param_shardings, self._opt_shardings,
+                     self._buffer_shardings, self._repl)
+        if with_outputs:
+            shardings = shardings + (None,)  # outputs: let GSPMD place
+        return jax.jit(step, out_shardings=shardings,
+                       donate_argnums=donate)
 
     def _build_accum(self, n_inputs, n_labels):
         def accum(params, grad_buf, buffers, *batch):
             inputs, labels = batch[:n_inputs], batch[n_inputs:]
-            loss, new_buffers, grads = self._grads_fn(
+            loss, new_buffers, grads, _ = self._grads_fn(
                 params, buffers, inputs, labels)
             new_buf = {n: grad_buf[n] + grads[n] for n in grad_buf}
             merged = dict(buffers)
@@ -381,10 +387,15 @@ class SpmdTrainer:
     def _build_eval(self, n_inputs):
         def fwd(params, buffers, *inputs):
             if self.amp_enabled:
+                # cast params AND floating inputs, like the train path —
+                # mixed fp32 inputs fail dtype-strict ops (conv) outright
                 cast = self.amp_dtype
                 params = jax.tree_util.tree_map(
                     lambda a: a.astype(cast) if _is_floating(a) else a,
                     params)
+                inputs = tuple(
+                    a.astype(cast) if hasattr(a, "dtype") and
+                    _is_floating(a) else a for a in inputs)
             out, _ = functional_call(self.model, params, buffers, *inputs,
                                      training=False)
             return out
@@ -392,31 +403,42 @@ class SpmdTrainer:
         return jax.jit(fwd)
 
     # ------------------------------------------------------------------
-    def train_step(self, inputs, labels):
+    def train_step(self, inputs, labels, return_outputs=False):
         """Run one compiled training step. inputs/labels: array, Tensor,
         or tuple thereof. Returns the loss as a device array (no host
-        sync — call float() when you actually need the number)."""
+        sync — call float() when you actually need the number); with
+        return_outputs=True returns (loss, outputs) — the forward outputs
+        ride along for metric computation (hapi)."""
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
         labels = labels if isinstance(labels, (tuple, list)) else (labels,)
         batch = self.shard_batch(tuple(inputs) + tuple(labels))
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = ("fused", len(inputs), len(labels))
 
         if self.k_steps == 1:
+            key = ("fused_out" if return_outputs else "fused",
+                   len(inputs), len(labels))
             if key not in self._compiled:
                 self._compiled[key] = self._build_fused(
-                    len(inputs), len(labels))
+                    len(inputs), len(labels), with_outputs=return_outputs)
             step_no = jnp.asarray(self._step_count + 1, jnp.int32)
             # the ambient mesh lets layers place sharding constraints on
             # intermediates (MoE dispatch buffers) while jit traces
             with mesh_guard(self.mesh):
-                (self.params, self.opt_state, self.buffers,
-                 loss) = self._compiled[key](
+                res = self._compiled[key](
                     self.params, self.opt_state, self.buffers, lr, step_no,
                     *batch)
+            if return_outputs:
+                (self.params, self.opt_state, self.buffers, loss,
+                 outs) = res
+            else:
+                self.params, self.opt_state, self.buffers, loss = res
             self._step_count += 1
             self.optimizer._step_count = self._step_count
-            return loss
+            return (loss, outs) if return_outputs else loss
+        if return_outputs:
+            raise NotImplementedError(
+                "return_outputs with gradient merge (k_steps > 1) is not "
+                "supported; drop metrics or gradient_merge")
 
         akey = ("accum", len(inputs), len(labels))
         if akey not in self._compiled:
@@ -461,6 +483,22 @@ class SpmdTrainer:
             if n in buf_objs and buf_objs[n] is not None:
                 buf_objs[n]._data = a
         return self.model
+
+    def sync_from_model(self):
+        """Adopt the model's current Tensor values as the trainer state
+        (after a checkpoint load into the model) — the reverse of
+        sync_to_model; re-places every array with its mesh sharding."""
+        self.params = {
+            n: jax.device_put(jnp.asarray(p.data),
+                              self._param_shardings[n])
+            for n, p in self._param_objs.items()}
+        buf_objs = dict(self.model.named_buffers())
+        self.buffers = {
+            n: jax.device_put(jnp.asarray(buf_objs[n].data),
+                              self._buffer_shardings[n])
+            if n in buf_objs and buf_objs[n] is not None else a
+            for n, a in self.buffers.items()}
+        return self
 
     def state_dict(self):
         sd = {n: Tensor(a) for n, a in self.params.items()}

@@ -36,22 +36,91 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._mesh = None
+        self._strategy = None
+        self._trainer = None
 
     # ---- setup ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, mesh=None, strategy=None):
+        """reference hapi/model.py:810, extended the TPU-native way: pass
+        mesh= (a jax.sharding.Mesh or {'dp': 8}-style dict) and/or
+        strategy= (DistributedStrategy) and fit/evaluate/predict run the
+        COMPILED SpmdTrainer step — the reference's CompiledProgram +
+        ParallelExecutor chain (fleet_base.py:1066) collapsed into one
+        XLA executable. Without them the eager per-op loop is used."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             if not isinstance(m, Metric):
                 raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        # a re-prepare invalidates any trainer built for the old
+        # optimizer/loss/mesh combination; pull its live arrays back into
+        # the network first (the trainer's compiled step DONATES its
+        # previous buffers, so the network's may already be deleted)
+        if self._trainer is not None:
+            self._trainer.sync_to_model()
+        self._trainer = None
+        self._mesh = None
+        self._strategy = None
+        # fleet.distributed_optimizer carries its strategy along
+        strategy = strategy or getattr(optimizer, "user_defined_strategy",
+                                       None)
+        if mesh is not None or strategy is not None:
+            from ..distributed.mesh import create_mesh, Mesh, default_mesh
+            if isinstance(mesh, dict):
+                mesh = create_mesh(mesh)
+            self._mesh = mesh if mesh is not None else default_mesh()
+            self._strategy = strategy
+        return self
+
+    @property
+    def compiled(self) -> bool:
+        return self._mesh is not None
+
+    def _ensure_trainer(self):
+        if self._trainer is not None:
+            return self._trainer
+        from ..distributed.spmd import SpmdTrainer
+        if self._strategy is not None and self._strategy.pipeline:
+            raise NotImplementedError(
+                "strategy.pipeline in Model.fit: split the network with "
+                "gpt_pipeline_parts-style stage views and use "
+                "paddle_tpu.distributed.pipeline.GPipeTrainer directly")
+        opt = getattr(self._optimizer, "inner_opt", self._optimizer)
+
+        def loss_fn(outputs, *labels):
+            outs = _to_list(outputs)
+            return self._loss(*(outs + [self._t(l) for l in labels]))
+
+        self._trainer = SpmdTrainer(self.network, opt, loss_fn,
+                                    mesh=self._mesh,
+                                    strategy=self._strategy)
+        return self._trainer
 
     # ---- single-batch ops (reference Model.train_batch/eval_batch) -------
     def train_batch(self, inputs, labels=None, update=True):
-        self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self.compiled and not update:
+            raise NotImplementedError(
+                "accumulate_grad_batches > 1 with a compiled Model: use "
+                "strategy.gradient_merge (the accumulation then happens "
+                "inside the compiled step with a dp-sharded buffer)")
+        if self.compiled and update:
+            tr = self._ensure_trainer()
+            want_out = bool(self._metrics)
+            if want_out:
+                loss, outputs = tr.train_step(tuple(inputs), tuple(labels),
+                                              return_outputs=True)
+                out_t = [Tensor(o) for o in _to_list(outputs)]
+                metrics = self._update_metrics(out_t, labels)
+            else:
+                loss = tr.train_step(tuple(inputs), tuple(labels))
+                metrics = {}
+            return ([float(loss)], metrics) if metrics else [float(loss)]
+        self.network.train()
         outputs = self.network(*[self._t(i) for i in inputs])
         losses = self._compute_loss(outputs, labels)
         losses.backward()
@@ -63,9 +132,18 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         from ..core.autograd import no_grad
-        self.network.eval()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self.compiled:
+            tr = self._ensure_trainer()
+            outputs = [Tensor(o) for o in
+                       _to_list(tr.eval_step(tuple(inputs)))]
+            losses = self._compute_loss(outputs, labels) \
+                if self._loss is not None else None
+            metrics = self._update_metrics(outputs, labels)
+            loss_list = [float(losses)] if losses is not None else []
+            return (loss_list, metrics) if metrics else loss_list
+        self.network.eval()
         with no_grad():
             outputs = self.network(*[self._t(i) for i in inputs])
             losses = self._compute_loss(outputs, labels) \
@@ -76,8 +154,12 @@ class Model:
 
     def predict_batch(self, inputs):
         from ..core.autograd import no_grad
-        self.network.eval()
         inputs = _to_list(inputs)
+        if self.compiled:
+            tr = self._ensure_trainer()
+            return [Tensor(o) for o in
+                    _to_list(tr.predict_step(tuple(inputs)))]
+        self.network.eval()
         with no_grad():
             outputs = self.network(*[self._t(i) for i in inputs])
         return _to_list(outputs)
@@ -211,6 +293,9 @@ class Model:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if self._trainer is not None:
+            # trainer owns the live arrays in compiled mode
+            self._trainer.sync_to_model()
         from ..framework.io import save as fsave
         fsave(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
@@ -222,6 +307,10 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(fload(path + ".pdopt"))
+        if self._trainer is not None:
+            # compiled mode: the trainer owns the live arrays — adopt the
+            # loaded weights or the restore would silently no-op
+            self._trainer.sync_from_model()
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)

@@ -194,7 +194,7 @@ class GPTAttention(Layer):
             # in UN-expanded (GQA): the ring rotates Hkv heads, not H.
             from ..distributed.ring_attention import \
                 sequence_parallel_attention
-            if cfg.attn_dropout:
+            if cfg.attn_dropout and self.training:
                 raise NotImplementedError(
                     "attn_dropout inside ring attention is not supported")
             out = sequence_parallel_attention(
@@ -204,16 +204,17 @@ class GPTAttention(Layer):
             out = self.dropout(out)
             return (out, new_cache) if cache is not None else out
 
-        if cfg.num_kv_heads != cfg.num_heads:
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = repeat_interleave(k, rep, axis=2)
-            v = repeat_interleave(v, rep, axis=2)
-
         if cfg.use_flash_attention and attn_mask is None and empty_cache:
+            # GQA goes in un-expanded: the Pallas kernel walks kv-head
+            # groups on its grid, never materializing repeated K/V
             out = F.flash_attention(q, k, v, dropout=cfg.attn_dropout,
                                     causal=causal,
                                     training=self.training)
         else:
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                k = repeat_interleave(k, rep, axis=2)
+                v = repeat_interleave(v, rep, axis=2)
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,
                 dropout_p=cfg.attn_dropout, is_causal=causal,

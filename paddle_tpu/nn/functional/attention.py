@@ -68,18 +68,39 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, training=True, name=None):
+                    return_softmax=False, training=True, kv_mask=None,
+                    name=None):
     """Flash-attention entry point; uses the Pallas TPU kernel when
-    available (paddle_tpu.ops.flash_attention), XLA composite otherwise."""
+    available (paddle_tpu.ops.flash_attention: fused fwd+bwd, native GQA
+    — k/v may carry fewer heads), XLA composite otherwise. kv_mask [B,S]
+    (1 = attend) covers padded-batch pretraining without an O(S^2) bias."""
     from ... import ops as _ops
 
     if (_ops.flash_attention_available() and dropout == 0.0
             and not return_softmax):
-        def fn(q, k, v):
-            return _ops.flash_attention(q, k, v, causal=causal)
-        out = apply(fn, query, key, value, name="flash_attention")
+        def fn(q, k, v, *rest):
+            m = rest[0] if rest else None
+            return _ops.flash_attention(q, k, v, causal=causal, kv_mask=m)
+        args = [query, key, value]
+        if kv_mask is not None:
+            args.append(kv_mask)
+        out = apply(fn, *args, name="flash_attention")
         return (out, None) if return_softmax else out
 
-    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
-                                       is_causal=causal, training=training)
+    # composite fallback: expand GQA heads (the kernel handles them
+    # natively; the composite needs full-head k/v)
+    h = (query.shape[2] if hasattr(query, "shape") else None)
+    hkv = (key.shape[2] if hasattr(key, "shape") else None)
+    if h is not None and hkv is not None and h != hkv:
+        from ...tensor.manipulation import repeat_interleave
+        key = repeat_interleave(key, h // hkv, axis=2)
+        value = repeat_interleave(value, h // hkv, axis=2)
+    mask_bias = None
+    if kv_mask is not None:
+        arr = kv_mask.data if hasattr(kv_mask, "data") else kv_mask
+        mask_bias = jnp.where(arr[:, None, None, :] > 0, 0.0, -1e30) \
+            .astype(jnp.float32)
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=mask_bias, dropout_p=dropout,
+        is_causal=causal, training=training)
     return (out, None) if return_softmax else out

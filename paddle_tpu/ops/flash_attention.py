@@ -1,15 +1,31 @@
-"""Pallas TPU flash attention (blockwise online-softmax).
+"""Pallas TPU flash attention — fused forward AND backward.
 
 The reference has no training-time fused attention (only the inference
 fused/multihead_matmul_op.cu); this kernel is the TPU-native upgrade: the
-[B,H,S,S] score matrix never leaves VMEM — each q-block streams k/v-blocks
-through the MXU with running max/denominator, so HBM traffic is O(S·D)
-instead of O(S²). Backward recomputes attention via the XLA composite
-(standard flash recompute strategy; a Pallas backward kernel can slot in
-behind the same custom_vjp later).
+[B,H,S,S] score matrix never leaves VMEM in either direction — forward
+streams k/v blocks through the MXU with a running max/denominator
+(online softmax), backward recomputes the probabilities blockwise from
+the saved per-row logsumexp (the standard flash recompute strategy), so
+HBM traffic is O(S·D) instead of O(S²) for fwd and bwd alike.
 
-Layout contract: q, k, v are [B, S, H, D] (paddle flash_attention layout);
-internally processed per (batch, head).
+Backward = two kernels sharing the recompute:
+  - dq: per q-block, loop over k-blocks; dq_i = scale * Σ_j ds_ij k_j
+  - dk/dv: per k-block, loop over q-blocks (and GQA groups);
+    dv_j = Σ_i p_ij do_i, dk_j = scale * Σ_i ds_ij q_i
+  with p_ij = exp(scale·q_i·k_j − lse_i), ds_ij = p_ij (do_i·v_j − δ_i),
+  δ_i = do_i·o_i (one cheap XLA rowsum before the kernels).
+All inner [block_q, block_k] tiles live in registers/VMEM only.
+
+GQA is native: q is laid out [B·Hkv, G, S, D] and k/v [B·Hkv, S, D]; the
+grid walks (kv-head, group, block), so grouped-query models never
+materialize repeat_interleaved K/V (G enters as a grid dimension, and
+the dk/dv kernel accumulates over it in-place across grid steps).
+
+An optional key-padding mask [B, S] (1 = attend, 0 = masked) covers the
+padded-batch pretraining case without an O(S²) bias tensor; arbitrary
+additive masks still fall back to the XLA composite.
+
+Layout contract: q [B, S, H, D], k/v [B, S, Hkv, D] with H % Hkv == 0.
 """
 from __future__ import annotations
 
@@ -28,6 +44,7 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 _INTERPRET = False  # set True in tests to run the kernel on CPU
+_NEG = -1e30
 
 
 def set_interpret_mode(flag: bool):
@@ -46,25 +63,24 @@ def flash_attention_available() -> bool:
         return False
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-               scale: float, q_offset_blocks: int):
-    """One (batch*head, q_block) program: online softmax over k blocks.
-
-    q_ref: [block_q, d]; k_ref/v_ref: [S, d] (whole sequence for this head
-    in VMEM); o_ref: [block_q, d].
-    """
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
+                block_k: int, causal: bool, scale: float):
+    """One (bh, g, q_block) program. q_ref [bq,d]; k/v [S,d]; m_ref (1,S)
+    key mask; outputs o [bq,d] and lse (1,bq)."""
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
     n_k = s // block_k
 
     q = q_ref[:].astype(jnp.float32) * scale
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * block_q
 
-    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    q_start = (qi + q_offset_blocks) * block_q
 
     def body(j, carry):
         m, l, acc = carry
@@ -72,15 +88,20 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         sblk = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        # reshape the f32 mask BEFORE comparing: mosaic can't insert a
+        # minor dim on 1-bit vectors
+        kv_f = m_ref[0, pl.ds(j * block_k, block_k)]       # (bk,) f32
+        sblk = jnp.where(kv_f[None, :] > 0, sblk, _NEG)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            sblk = jnp.where(rows >= cols, sblk, -1e30)
+            sblk = jnp.where(rows >= cols, sblk, _NEG)
         m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
         p = jnp.exp(sblk - m_new)
+        p = jnp.where(sblk <= _NEG / 2, 0.0, p)  # fully-masked rows
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
@@ -89,45 +110,274 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         return m_new, l_new, acc_new
 
     if causal:
-        # only k blocks that intersect the causal triangle for this q block
         last = (q_start + block_q + block_k - 1) // block_k
-        n_iter = jnp.minimum(last, n_k)
+        n_iter = min(last, n_k) if isinstance(last, int) \
+            else jnp.minimum(last, n_k)
     else:
         n_iter = n_k
     m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, :] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _fa_forward_bhsd(q, k, v, causal, block_q=256, block_k=256):
-    """q,k,v: [BH, S, D] -> out [BH, S, D]. Block sizes must divide S —
-    pick the largest power-of-two block ≤ requested that does."""
-    bh, s, d = q.shape
-    while s % block_q != 0:
-        block_q //= 2
-    while s % block_k != 0:
-        block_k //= 2
+# ---------------------------------------------------------------------------
+# backward kernels (everything in [bk, bq] orientation: lse/delta live on
+# the lane axis, so no sublane broadcasts or transposes are emitted)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, m_ref,
+                   dq_ref, *, block_k: int, causal: bool, scale: float):
+    """One (bh, g, q_block): dq for this q block."""
+    block_q, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    qs = q_ref[:].astype(jnp.float32) * scale              # [bq, d]
+    do = do_ref[:].astype(jnp.float32)                     # [bq, d]
+    lse = lse_ref[0, :]                                    # (bq,)
+    delta = dl_ref[0, :]                                   # (bq,)
+
+    def body(j, dq_acc):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        st = jax.lax.dot_general(
+            k_blk, qs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, bq]
+        kv_f = m_ref[0, pl.ds(j * block_k, block_k)]       # (bk,) f32
+        st = jnp.where(kv_f[:, None] > 0, st, _NEG)
+        if causal:
+            krows = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            qcols = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(qcols >= krows, st, _NEG)
+        pT = jnp.exp(st - lse[None, :])                    # [bk, bq]
+        pT = jnp.where(st <= _NEG / 2, 0.0, pT)
+        dpT = jax.lax.dot_general(
+            v_blk, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, bq]
+        dsT = pT * (dpT - delta[None, :])
+        return dq_acc + jax.lax.dot_general(
+            dsT, k_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, d]
+
+    if causal:
+        last = (q_start + block_q + block_k - 1) // block_k
+        n_iter = min(last, n_k) if isinstance(last, int) \
+            else jnp.minimum(last, n_k)
+    else:
+        n_iter = n_k
+    dq = jax.lax.fori_loop(0, n_iter, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref, m_ref,
+                    dk_ref, dv_ref, *, block_q: int, causal: bool,
+                    scale: float, n_groups: int):
+    """One (bh, k_block, g): dk/dv for this k block, accumulated over the
+    GQA group grid dimension (g innermost; init at g == 0)."""
+    block_k, d = k_ref.shape
+    s = q_ref.shape[0]
+    n_q = s // block_q
+    kj = pl.program_id(1)
+    g = pl.program_id(2)
+    k_start = kj * block_k
+
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    kv_f = m_ref[0, pl.ds(k_start, block_k)]               # (bk,) f32
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :] \
+            .astype(jnp.float32) * scale                   # [bq, d]
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]      # (bq,)
+        delta = dl_ref[0, pl.ds(i * block_q, block_q)]
+        st = jax.lax.dot_general(
+            k_blk, q_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, bq]
+        st = jnp.where(kv_f[:, None] > 0, st, _NEG)
+        if causal:
+            krows = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            qcols = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(qcols >= krows, st, _NEG)
+        pT = jnp.exp(st - lse[None, :])
+        pT = jnp.where(st <= _NEG / 2, 0.0, pT)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pT, do_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dpT = jax.lax.dot_general(
+            v_blk, do_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, bq]
+        dsT = pT * (dpT - delta[None, :])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            dsT, q_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        return dk_acc, dv_acc
+
+    i0 = k_start // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        i0, n_q, body, (jnp.zeros((block_k, d), jnp.float32),
+                        jnp.zeros((block_k, d), jnp.float32)))
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[:] = dk.astype(dk_ref.dtype)
+        dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    if n_groups > 1:
+        @pl.when(g > 0)
+        def _accum():
+            dk_ref[:] = dk_ref[:] + dk.astype(dk_ref.dtype)
+            dv_ref[:] = dv_ref[:] + dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers over the GQA layout
+#   q4 [BHkv, G, S, D], k3/v3 [BHkv, S, D], mask [B, 1, S]
+# ---------------------------------------------------------------------------
+def _pick_block(s, want=256):
+    while s % want:
+        want //= 2
+    return want
+
+
+def _fwd_gqa(q4, k3, v3, mask, causal, block_q=256, block_k=256):
+    bhkv, g, s, d = q4.shape
+    hkv = bhkv // mask.shape[0]
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // block_q)
-
-    kernel = functools.partial(_fa_kernel, block_k=block_k, causal=causal,
-                               scale=scale, q_offset_blocks=0)
+    grid = (bhkv, g, s // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, gi, i: (b, gi, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s),
+                         lambda b, gi, i, hkv=hkv: (b // hkv, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, gi, i: (b, gi, i, 0)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, gi, i: (b, gi, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhkv, g, s, d), q4.dtype),
+            jax.ShapeDtypeStruct((bhkv, g, 1, s), jnp.float32),
+        ],
         interpret=_INTERPRET,
-    )(q, k, v)
+    )(q4, k3, v3, mask)
 
 
-def _composite(q, k, v, causal):
-    """XLA reference math on [B,S,H,D]."""
-    d = q.shape[-1]
+def _bwd_gqa(q4, k3, v3, mask, o4, lse, do4, causal,
+             block_q=256, block_k=256):
+    bhkv, g, s, d = q4.shape
+    hkv = bhkv // mask.shape[0]
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = do_i · o_i — one fused XLA rowsum, O(S·D)
+    delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]                # [BHkv,G,1,S]
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, block_k=block_k,
+                                  causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bhkv, g, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, gi, i: (b, gi, i, 0)),   # q
+            pl.BlockSpec((None, s, d), lambda b, gi, i: (b, 0, 0)),  # k
+            pl.BlockSpec((None, s, d), lambda b, gi, i: (b, 0, 0)),  # v
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, gi, i: (b, gi, i, 0)),   # do
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, gi, i: (b, gi, 0, i)),   # lse
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, gi, i: (b, gi, 0, i)),   # delta
+            pl.BlockSpec((None, 1, s),
+                         lambda b, gi, i, hkv=hkv: (b // hkv, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda b, gi, i: (b, gi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhkv, g, s, d), q4.dtype),
+        interpret=_INTERPRET,
+    )(q4, k3, v3, do4, lse, delta, mask)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                                   causal=causal, scale=scale,
+                                   n_groups=g)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bhkv, s // block_k, g),   # g innermost: in-place accumulate
+        in_specs=[
+            pl.BlockSpec((None, block_k, d),
+                         lambda b, j, gi: (b, j, 0)),       # k
+            pl.BlockSpec((None, block_k, d),
+                         lambda b, j, gi: (b, j, 0)),       # v
+            pl.BlockSpec((None, None, s, d),
+                         lambda b, j, gi: (b, gi, 0, 0)),   # q (one group)
+            pl.BlockSpec((None, None, s, d),
+                         lambda b, j, gi: (b, gi, 0, 0)),   # do
+            pl.BlockSpec((None, None, 1, s),
+                         lambda b, j, gi: (b, gi, 0, 0)),   # lse
+            pl.BlockSpec((None, None, 1, s),
+                         lambda b, j, gi: (b, gi, 0, 0)),   # delta
+            pl.BlockSpec((None, 1, s),
+                         lambda b, j, gi, hkv=hkv: (b // hkv, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j, gi: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, gi: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bhkv, s, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(k3, v3, q4, do4, lse, delta, mask)
+    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+# ---------------------------------------------------------------------------
+# layout shuffles [B,S,H,D] <-> GQA grid layout
+# ---------------------------------------------------------------------------
+def _to_gqa(q, k, v):
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    # q head index = hk * g + gi (repeat_interleave convention)
+    q4 = jnp.swapaxes(q, 1, 2).reshape(b * hkv, g, s, d)
+    k3 = jnp.swapaxes(k, 1, 2).reshape(b * hkv, s, d)
+    v3 = jnp.swapaxes(v, 1, 2).reshape(b * hkv, s, d)
+    return q4, k3, v3
+
+
+def _from_gqa_q(o4, b, s, h, d):
+    return jnp.swapaxes(o4.reshape(b, h, s, d), 1, 2)
+
+
+def _composite(q, k, v, causal, kv_mask=None):
+    """XLA reference math on [B,S,H,D] (k/v may have fewer heads)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -136,35 +386,60 @@ def _composite(q, k, v, causal):
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        scores = jnp.where(mask, scores, -1e30)
+        scores = jnp.where(mask, scores, _NEG)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :] > 0, scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def flash_attention(q, k, v, causal=False):
-    """q,k,v: [B, S, H, D]. Fused Pallas forward; recompute backward."""
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash(q, k, v, mask, causal):
+    o, _ = _flash_fwd_impl(q, k, v, mask, causal)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, mask, causal):
     b, s, h, d = q.shape
-    sk = k.shape[1]
-    supported = (s == sk and s % 128 == 0 and (d % 128 == 0 or d == 64))
+    q4, k3, v3 = _to_gqa(q, k, v)
+    o4, lse = _fwd_gqa(q4, k3, v3, mask, causal)
+    return _from_gqa_q(o4, b, s, h, d), (q, k, v, mask, o4, lse)
+
+
+def _flash_fwd(q, k, v, mask, causal):
+    return _flash_fwd_impl(q, k, v, mask, causal)
+
+
+def _flash_bwd(causal, res, g_out):
+    q, k, v, mask, o4, lse = res
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    q4, k3, v3 = _to_gqa(q, k, v)
+    do4 = jnp.swapaxes(g_out, 1, 2).reshape(b * hkv, h // hkv, s, d)
+    dq4, dk3, dv3 = _bwd_gqa(q4, k3, v3, mask, o4, lse, do4, causal)
+    dq = _from_gqa_q(dq4, b, s, h, d).astype(q.dtype)
+    dk = jnp.swapaxes(dk3.reshape(b, hkv, s, d), 1, 2)
+    dv = jnp.swapaxes(dv3.reshape(b, hkv, s, d), 1, 2)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, kv_mask=None):
+    """q [B,S,H,D]; k/v [B,S,Hkv,D] (GQA native — no head expansion);
+    kv_mask optional [B,S] (1 = key attended, 0 = padding). Pallas fused
+    fwd+bwd when shapes allow, XLA composite otherwise."""
+    b, s, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    supported = (s == sk and s % 128 == 0 and (d % 128 == 0 or d == 64)
+                 and h % hkv == 0)
     if not supported or not flash_attention_available():
-        return _composite(q, k, v, causal)
-    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
-    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
-    out = _fa_forward_bhsd(qf, kf, vf, causal)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
-
-
-def _fa_fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
-
-
-def _fa_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _composite(a, b, c, causal), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+        return _composite(q, k, v, causal, kv_mask)
+    mask = jnp.ones((b, 1, s), jnp.float32) if kv_mask is None \
+        else kv_mask.reshape(b, 1, s).astype(jnp.float32)
+    return _flash(q, k, v, mask, causal)

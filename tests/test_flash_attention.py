@@ -1,0 +1,158 @@
+"""Pallas flash attention kernel tests (interpret mode on CPU).
+
+Ground truth is the module's own XLA composite (`_composite`), itself
+verified against `_sdpa_reference` elsewhere. Covers fwd, the fused
+Pallas backward (dq/dk/dv from saved logsumexp), native GQA, and the
+key-padding mask.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import importlib
+
+# ops/__init__ re-exports the flash_attention FUNCTION under the same
+# name as the module; fetch the module itself
+fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fa.set_interpret_mode(True)
+    yield
+    fa.set_interpret_mode(False)
+
+
+def make_qkv(b=2, s=256, h=4, hkv=None, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    hkv = hkv or h
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.3)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_composite(causal):
+    q, k, v = make_qkv()
+    out = fa.flash_attention(q, k, v, causal=causal)
+    ref = fa._composite(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_multi_block():
+    """S=512 with block 256 exercises the online-softmax block loop."""
+    q, k, v = make_qkv(b=1, s=512, h=2)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = fa._composite(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_composite(causal):
+    q, k, v = make_qkv(b=1, s=256, h=2)
+
+    def loss_flash(q_, k_, v_):
+        return (fa.flash_attention(q_, k_, v_, causal=causal)
+                .astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q_, k_, v_):
+        return (fa._composite(q_, k_, v_, causal)
+                .astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_backward_multi_block_causal():
+    q, k, v = make_qkv(b=1, s=512, h=2, seed=3)
+
+    def loss(fn):
+        return lambda q_, k_, v_: (fn(q_, k_, v_).astype(jnp.float32)
+                                   * jnp.cos(q_)).sum()
+
+    gf = jax.grad(loss(lambda a, b, c: fa.flash_attention(
+        a, b, c, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda a, b, c: fa._composite(a, b, c, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_forward_and_backward():
+    """k/v with Hkv=2 < H=8 heads, never expanded: parity with the
+    composite (which expands internally)."""
+    q, k, v = make_qkv(b=2, s=256, h=8, hkv=2, seed=5)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = fa._composite(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q_, k_, v_):
+        return (fa.flash_attention(q_, k_, v_, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q_, k_, v_):
+        return (fa._composite(q_, k_, v_, True)
+                .astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.shape == b.shape  # dk/dv stay at Hkv heads
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_kv_mask_forward_and_backward():
+    """Key-padding mask: last quarter of keys masked out."""
+    q, k, v = make_qkv(b=2, s=256, h=2, seed=7)
+    mask = np.ones((2, 256), np.float32)
+    mask[:, 192:] = 0.0
+    mask = jnp.asarray(mask)
+
+    out = fa.flash_attention(q, k, v, causal=False, kv_mask=mask)
+    ref = fa._composite(q, k, v, False, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    gf = jax.grad(lambda a, b, c: (fa.flash_attention(
+        a, b, c, causal=True, kv_mask=mask) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: (fa._composite(
+        a, b, c, True, kv_mask=mask) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    # masked keys receive zero dk/dv
+    assert np.allclose(np.asarray(gf[1])[:, 192:], 0.0)
+    assert np.allclose(np.asarray(gf[2])[:, 192:], 0.0)
+
+
+def test_bf16_inputs():
+    q, k, v = make_qkv(b=1, s=256, h=2)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = fa.flash_attention(qb, kb, vb, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = fa._composite(qb, kb, vb, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_unsupported_shapes_fall_back():
+    # s % 128 != 0 -> composite (still correct)
+    q, k, v = make_qkv(b=1, s=100, h=2)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = fa._composite(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6)

@@ -1,0 +1,201 @@
+"""hapi Model + fleet wiring of the compiled trainer (VERDICT r2 #3).
+
+Reference chain being replaced: Model.fit -> CompiledProgram ->
+ParallelExecutor (hapi/model.py:810,1244 + fleet_base.py:1066). Done
+criterion: LeNet Model.fit on the 8-CPU mesh trains compiled with a loss
+curve identical to the eager loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import create_mesh
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.hapi import Model
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.models import LeNet
+
+
+class _Digits:
+    """Tiny synthetic MNIST-shaped dataset."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 1, 28, 28).astype(np.float32)
+        self.y = rng.randint(0, 10, (n, 1)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _losses_from_fit(model, data, epochs=2, bs=16):
+    seen = []
+
+    class Rec(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(float(logs["loss"]))
+
+    model.fit(data, batch_size=bs, epochs=epochs, verbose=0,
+              shuffle=False, callbacks=[Rec()])
+    return seen
+
+
+def test_lenet_fit_compiled_matches_eager():
+    data = _Digits()
+
+    paddle.seed(7)
+    m_eager = Model(LeNet())
+    m_eager.prepare(paddle.optimizer.Adam(
+        learning_rate=1e-3, parameters=m_eager.parameters()),
+        nn.CrossEntropyLoss())
+    eager = _losses_from_fit(m_eager, data)
+    assert not m_eager.compiled
+
+    paddle.seed(7)
+    m_comp = Model(LeNet())
+    m_comp.prepare(paddle.optimizer.Adam(
+        learning_rate=1e-3, parameters=m_comp.parameters()),
+        nn.CrossEntropyLoss(), mesh={"dp": 8})
+    comp = _losses_from_fit(m_comp, data)
+    assert m_comp.compiled
+    # one executable per step, state sharded on the mesh
+    tr = m_comp._trainer
+    assert tr is not None and tr.step_executable is not None
+    leaf = next(iter(tr.params.values()))
+    assert len(leaf.sharding.device_set) == 8
+    np.testing.assert_allclose(comp, eager, rtol=2e-4, atol=2e-5)
+
+
+def test_compiled_fit_with_metrics_and_eval():
+    data = _Digits(48)
+    paddle.seed(1)
+    m = Model(LeNet())
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters()),
+              nn.CrossEntropyLoss(), metrics=Accuracy(),
+              mesh={"dp": 8})
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    res = m.evaluate(data, batch_size=16, verbose=0)
+    assert "loss" in res and "acc" in res
+    preds = m.predict(data, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (48, 10)
+
+
+def test_compiled_fit_with_strategy_amp_recompute_free():
+    """strategy= alone (no mesh) also selects the compiled path."""
+    data = _Digits(32)
+    paddle.seed(3)
+    st = DistributedStrategy()
+    st.amp = True
+    m = Model(LeNet())
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters()),
+              nn.CrossEntropyLoss(), strategy=st)
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    assert m.compiled and m._trainer.amp_enabled
+
+
+def test_fleet_distributed_model_builds_trainer():
+    """fleet.distributed_optimizer strategy reaches the compiled trainer
+    through fleet.distributed_model (reference fleet.minimize chain)."""
+    from paddle_tpu.distributed import fleet
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+    st = DistributedStrategy()
+    st.sharding = True
+    st.sharding_configs = {"stage": 2}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=net.parameters()), st)
+    loss_fn = lambda out, lab: nn.functional.cross_entropy(out, lab)
+    tr = fleet.distributed_model(net, opt, loss_fn,
+                                 mesh=create_mesh({"dp": 8}))
+    assert tr.zero_stage == 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 10, (16,)).astype(np.int64)
+    l0 = float(tr.train_step(x, y))
+    l5 = [float(tr.train_step(x, y)) for _ in range(5)][-1]
+    assert l5 < l0
+
+
+def test_fleet_optimizer_through_model_prepare():
+    """Model.prepare picks the strategy straight off a
+    fleet.DistributedOptimizer (no explicit strategy kwarg)."""
+    from paddle_tpu.distributed import fleet
+    data = _Digits(32)
+    paddle.seed(9)
+    m = Model(LeNet())
+    st = DistributedStrategy()
+    st.recompute = False
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=m.parameters()), st)
+    m.prepare(opt, nn.CrossEntropyLoss(), mesh={"dp": 4})
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    assert m.compiled
+
+
+def test_compiled_amp_eval_casts_inputs():
+    """Verify regression: eval/predict under bf16 AMP must cast floating
+    inputs like the train path (conv is dtype-strict)."""
+    data = _Digits(32)
+    paddle.seed(11)
+    st = DistributedStrategy()
+    st.amp = True
+    m = Model(LeNet())
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters()),
+              nn.CrossEntropyLoss(), metrics=Accuracy(), mesh={"dp": 2},
+              strategy=st)
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    res = m.evaluate(data, batch_size=16, verbose=0)
+    assert np.isfinite(res["loss"])
+    preds = m.predict(data, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+
+def test_load_restores_compiled_trainer(tmp_path):
+    """Review regression: Model.load after the trainer exists must adopt
+    the loaded weights (save/load round trip reproduces outputs)."""
+    data = _Digits(32)
+    paddle.seed(13)
+    m = Model(LeNet())
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters()),
+              nn.CrossEntropyLoss(), mesh={"dp": 2})
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    p = str(tmp_path / "ck")
+    m.save(p)
+    before = m.predict(data, batch_size=16, stack_outputs=True)[0]
+    m.fit(data, batch_size=16, epochs=1, verbose=0)  # drift the weights
+    drifted = m.predict(data, batch_size=16, stack_outputs=True)[0]
+    assert not np.allclose(drifted, before)
+    m.load(p)
+    restored = m.predict(data, batch_size=16, stack_outputs=True)[0]
+    np.testing.assert_allclose(restored, before, rtol=1e-5, atol=1e-6)
+
+
+def test_re_prepare_rebuilds_trainer():
+    """Review regression: a second prepare() must not reuse the trainer
+    built for the first optimizer/loss."""
+    data = _Digits(32)
+    paddle.seed(17)
+    m = Model(LeNet())
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters()),
+              nn.CrossEntropyLoss(), mesh={"dp": 2})
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    t1 = m._trainer
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=m.parameters()),
+              nn.CrossEntropyLoss(), mesh={"dp": 2})
+    assert m._trainer is None
+    m.fit(data, batch_size=16, epochs=1, verbose=0)
+    assert m._trainer is not t1
+    from paddle_tpu.optimizer import SGD
+    assert isinstance(m._trainer.optimizer, SGD)

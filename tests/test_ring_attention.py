@@ -160,3 +160,14 @@ def test_ring_attention_raises_on_bad_shapes():
     mesh = create_mesh({"sp": 4})
     with pytest.raises(ValueError, match="not divisible"):
         ring_attention(q, k, v, mesh=mesh, batch_axis=None)
+
+
+def test_ring_on_mesh_without_sp_axis_degenerates():
+    """Review regression: a mesh without an sp axis (or sp=1) must fall
+    back to dense attention instead of crashing shard_map."""
+    q, k, v = qkv()
+    ref = _sdpa_reference(q, k, v, is_causal=True)
+    out = ring_attention(q, k, v, mesh=create_mesh({"dp": 2}),
+                         causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
