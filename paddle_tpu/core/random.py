@@ -19,9 +19,15 @@ import jax
 
 
 class Generator:
+    """Key creation is LAZY: building a jax PRNG key initializes the XLA
+    backend, and `import paddle_tpu` must not do that — the reference
+    contract is `import paddle; init_parallel_env()`, and
+    jax.distributed.initialize only works BEFORE first backend use."""
+
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.manual_seed(seed)
+        self._seed = int(seed)
+        self._key = None
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
@@ -32,10 +38,21 @@ class Generator:
     def initial_seed(self) -> int:
         return self._seed
 
+    def _ensure_key(self):
+        """Lazy init under the lock (callers must hold self._lock)."""
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def next_key(self):
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             return sub
+
+    def state(self):
+        with self._lock:
+            return self._ensure_key()
 
 
 _default_generator = Generator(0)
@@ -52,7 +69,7 @@ def seed(n: int) -> Generator:
 
 
 def get_rng_state():
-    return _default_generator._key
+    return _default_generator.state()
 
 
 def set_rng_state(key):

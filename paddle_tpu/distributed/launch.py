@@ -1,0 +1,183 @@
+"""Multi-process launcher — `python -m paddle_tpu.distributed.launch`.
+
+Reference: python/paddle/distributed/fleet/launch.py:208
+(launch_collective), launch_utils.py:164 (Pod), :258 (get_cluster),
+:435-491 (start_local_trainers: one subprocess per device with
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env + log redirection),
+:526 (watch_local_trainers: tear the pod down when any trainer dies).
+
+TPU-native deltas: the rendezvous is JAX's coordinator service
+(jax.distributed.initialize inside env.init_parallel_env) instead of a
+raw-TCP ncclUniqueId exchange, so the launcher only has to agree on a
+coordinator address and export the same PADDLE_* env contract the
+reference uses. On a TPU pod slice the runtime usually launches one
+process per host out-of-band; this launcher covers single-host
+multi-process (CPU rings, tests — the reference's localhost cluster
+strategy, test_dist_base.py:668) and explicit multi-host via --ips.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["launch", "get_cluster", "Pod", "TrainerProc", "find_free_port"]
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class TrainerProc:
+    """reference launch_utils.py TrainerProc."""
+    rank: int
+    proc: subprocess.Popen
+    log_path: Optional[str] = None
+    log_fh: object = None
+
+
+@dataclass
+class Pod:
+    """This host's slice of the cluster (reference launch_utils.py:164)."""
+    addr: str
+    ranks: List[int] = field(default_factory=list)
+    endpoints: List[str] = field(default_factory=list)
+
+
+def get_cluster(ips: List[str], nproc_per_node: int,
+                start_port: Optional[int] = None):
+    """All endpoints + this host's Pod (reference get_cluster:258)."""
+    endpoints, pods = [], []
+    for ip in ips:
+        ports = [find_free_port() if (start_port is None and
+                                      ip in ("127.0.0.1", "localhost"))
+                 else (start_port or 6170) + i
+                 for i in range(nproc_per_node)]
+        pod = Pod(addr=ip)
+        for p in ports:
+            pod.ranks.append(len(endpoints))
+            ep = f"{ip}:{p}"
+            pod.endpoints.append(ep)
+            endpoints.append(ep)
+        pods.append(pod)
+    return endpoints, pods
+
+
+def _trainer_env(rank: int, world: int, endpoints: List[str],
+                 coordinator: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        # the reference's contract (launch_utils.py:435-466)
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        # TPU-native rendezvous (env.init_parallel_env)
+        "PADDLE_MASTER": coordinator,
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+    })
+    return env
+
+
+def start_local_trainers(pod: Pod, world: int, endpoints: List[str],
+                         coordinator: str, training_script: str,
+                         script_args: List[str],
+                         log_dir: Optional[str] = None
+                         ) -> List[TrainerProc]:
+    """reference start_local_trainers (launch_utils.py:435)."""
+    procs = []
+    for rank in pod.ranks:
+        env = _trainer_env(rank, world, endpoints, coordinator)
+        cmd = [sys.executable, "-u", training_script] + list(script_args)
+        log_fh, log_path = None, None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"workerlog.{rank}")
+            log_fh = open(log_path, "w")
+        proc = subprocess.Popen(
+            cmd, env=env,
+            stdout=log_fh if log_fh else None,
+            stderr=subprocess.STDOUT if log_fh else None)
+        procs.append(TrainerProc(rank=rank, proc=proc, log_path=log_path,
+                                 log_fh=log_fh))
+    return procs
+
+
+def watch_local_trainers(procs: List[TrainerProc],
+                         poll_interval: float = 0.5) -> int:
+    """Tear the pod down when any trainer dies (reference
+    watch_local_trainers, launch_utils.py:526). Returns the pod's exit
+    code (first non-zero child, else 0)."""
+    try:
+        while True:
+            alive, rc = 0, 0
+            for t in procs:
+                code = t.proc.poll()
+                if code is None:
+                    alive += 1
+                elif code != 0:
+                    rc = code
+            if rc != 0:
+                _terminate(procs)
+                return rc
+            if alive == 0:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:  # pragma: no cover
+        _terminate(procs)
+        raise
+    finally:
+        for t in procs:
+            if t.log_fh:
+                t.log_fh.close()
+
+
+def _terminate(procs: List[TrainerProc], grace: float = 3.0):
+    for t in procs:
+        if t.proc.poll() is None:
+            t.proc.terminate()
+    deadline = time.time() + grace
+    for t in procs:
+        while t.proc.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if t.proc.poll() is None:
+            t.proc.kill()
+
+
+def launch(args=None) -> int:
+    parser = argparse.ArgumentParser(
+        "paddle_tpu.distributed.launch",
+        description="start one training process per rank "
+                    "(reference fleet/launch.py)")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-separated host ips")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--start_port", type=int, default=None)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    a = parser.parse_args(args)
+
+    ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
+    endpoints, pods = get_cluster(ips, a.nproc_per_node, a.start_port)
+    # this launcher runs on the first ip (multi-host: run it per host)
+    pod = pods[0]
+    coordinator = f"{ips[0]}:{find_free_port()}" if ips[0] in (
+        "127.0.0.1", "localhost") else endpoints[0]
+    procs = start_local_trainers(pod, len(endpoints), endpoints,
+                                 coordinator, a.training_script,
+                                 a.script_args, a.log_dir)
+    return watch_local_trainers(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

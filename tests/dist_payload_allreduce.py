@@ -1,0 +1,39 @@
+"""2-rank payload driven by paddle_tpu.distributed.launch (the
+reference's dist_mnist.py-style separate-script pattern,
+test_dist_base.py:668). Each rank computes a gradient on its own data,
+allreduces it through the eager DataParallel path, and prints the
+result for the parent test to compare."""
+import jax
+
+# host-CPU backend: two processes must not both grab the TPU, and the
+# env var alone loses to an installed TPU plugin
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed import DataParallel, env  # noqa: E402
+
+
+def main():
+    env.init_parallel_env()
+    rank, world = env.get_rank(), env.get_world_size()
+    assert world == 2, f"expected 2 ranks, got {world}"
+    assert jax.process_count() == 2, "jax.distributed did not initialize"
+
+    paddle.seed(0)                      # identical init on every rank
+    model = nn.Linear(4, 2, bias_attr=False)
+    dp = DataParallel(model)
+
+    rng = np.random.RandomState(rank)   # different data per rank
+    x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+    loss = dp(x).sum()
+    loss.backward()
+    dp.apply_collective_grads()
+    g = np.asarray(model.weight.grad.data)
+    print(f"GRADSUM {rank} {float(g.sum()):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
