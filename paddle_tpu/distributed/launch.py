@@ -72,11 +72,11 @@ def get_cluster(ips: List[str], nproc_per_node: int,
     return endpoints, pods
 
 
-def _trainer_env(rank: int, world: int, endpoints: List[str],
-                 coordinator: str) -> dict:
-    env = dict(os.environ)
-    env.update({
-        # the reference's contract (launch_utils.py:435-466)
+def trainer_env_vars(rank: int, world: int, endpoints: List[str],
+                     coordinator: str) -> dict:
+    """The per-rank env contract — single source of truth shared with
+    spawn.py (reference launch_utils.py:435-466)."""
+    return {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
@@ -84,8 +84,25 @@ def _trainer_env(rank: int, world: int, endpoints: List[str],
         # TPU-native rendezvous (env.init_parallel_env)
         "PADDLE_MASTER": coordinator,
         "JAX_COORDINATOR_ADDRESS": coordinator,
-    })
+    }
+
+
+def _trainer_env(rank: int, world: int, endpoints: List[str],
+                 coordinator: str) -> dict:
+    env = dict(os.environ)
+    env.update(trainer_env_vars(rank, world, endpoints, coordinator))
     return env
+
+
+def _local_addrs() -> set:
+    addrs = {"127.0.0.1", "localhost"}
+    try:
+        host = socket.gethostname()
+        addrs.add(host)
+        addrs.add(socket.gethostbyname(host))
+    except OSError:  # pragma: no cover
+        pass
+    return addrs
 
 
 def start_local_trainers(pod: Pod, world: int, endpoints: List[str],
@@ -169,8 +186,18 @@ def launch(args=None) -> int:
 
     ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
     endpoints, pods = get_cluster(ips, a.nproc_per_node, a.start_port)
-    # this launcher runs on the first ip (multi-host: run it per host)
-    pod = pods[0]
+    # pick THIS host's pod (reference matches the node ip); each host of
+    # a multi-host cluster runs its own launcher over the same --ips
+    if len(pods) == 1:
+        pod = pods[0]
+    else:
+        local = _local_addrs()
+        mine = [p for p in pods if p.addr in local]
+        if not mine:
+            raise SystemExit(
+                f"none of --ips {ips} matches this host "
+                f"({sorted(local)}); include this host's ip")
+        pod = mine[0]
     coordinator = f"{ips[0]}:{find_free_port()}" if ips[0] in (
         "127.0.0.1", "localhost") else endpoints[0]
     procs = start_local_trainers(pod, len(endpoints), endpoints,

@@ -12,24 +12,19 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import time
 import traceback
 from typing import Optional, Tuple
 
-from .launch import find_free_port
+from .launch import find_free_port, trainer_env_vars
 
 __all__ = ["spawn", "SpawnContext"]
 
 
 def _worker(func, rank, world, coordinator, endpoints, args, err_q):
     try:
-        os.environ.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-            "PADDLE_MASTER": coordinator,
-            "JAX_COORDINATOR_ADDRESS": coordinator,
-        })
+        os.environ.update(
+            trainer_env_vars(rank, world, endpoints, coordinator))
         func(rank, *args)
     except Exception:
         err_q.put((rank, traceback.format_exc()))
@@ -42,17 +37,40 @@ class SpawnContext:
         self._err_q = err_q
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        for p in self.processes:
-            p.join(timeout)
-        if not self._err_q.empty():
-            rank, tb = self._err_q.get()
-            raise RuntimeError(
-                f"spawned trainer rank {rank} failed:\n{tb}")
-        bad = [p.exitcode for p in self.processes
-               if p.exitcode not in (0, None)]
-        if bad:
-            raise RuntimeError(f"spawned trainers exited with {bad}")
-        return all(p.exitcode == 0 for p in self.processes)
+        """Wait for all workers; on the FIRST failure terminate the
+        survivors (they may be blocked in a collective waiting for the
+        dead rank) and re-raise — the reference spawn's watch loop."""
+        deadline = time.time() + timeout if timeout else None
+
+        def fail(rank=None, tb=None, codes=None):
+            for p in self.processes:
+                if p.is_alive():
+                    p.terminate()
+            for p in self.processes:
+                p.join(5)
+            if tb is not None:
+                raise RuntimeError(
+                    f"spawned trainer rank {rank} failed:\n{tb}")
+            raise RuntimeError(f"spawned trainers exited with {codes}")
+
+        while True:
+            if not self._err_q.empty():
+                rank, tb = self._err_q.get()
+                fail(rank=rank, tb=tb)
+            bad = [p.exitcode for p in self.processes
+                   if p.exitcode not in (0, None)]
+            if bad:
+                # give the failed rank a moment to flush its traceback
+                time.sleep(0.2)
+                if not self._err_q.empty():
+                    rank, tb = self._err_q.get()
+                    fail(rank=rank, tb=tb)
+                fail(codes=bad)
+            if all(not p.is_alive() for p in self.processes):
+                return True
+            if deadline and time.time() > deadline:
+                return False
+            time.sleep(0.1)
 
 
 def spawn(func, args: Tuple = (), nprocs: int = 2, join: bool = True,

@@ -222,7 +222,10 @@ class Model:
         cbks.on_end("train")
 
     def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None):
+        from ..profiler import StepTimer
         logs = {}
+        timer = StepTimer(warmup=1)
+        timer.start()
         for m in self._metrics:
             m.reset()
         for step, batch in enumerate(loader):
@@ -244,6 +247,10 @@ class Model:
             logs.update(metrics)
             logs["batch_size"] = (labs[0].shape[0] if labs else
                                   ins[0].shape[0])
+            timer.tick()
+            if timer.last_ms is not None:
+                # per-step wall time (reference profiler summary table)
+                logs["step_time_ms"] = round(timer.last_ms, 3)
             cbks.on_batch_end(mode, step, logs)
         return logs
 

@@ -116,3 +116,31 @@ def test_import_does_not_initialize_backend(tmp_path):
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "LAZY_OK" in proc.stdout
+
+
+def test_spawn_failed_rank_terminates_survivors(tmp_path):
+    """Review regression: one rank dying must not deadlock join() while
+    the surviving rank waits in a collective."""
+    script = tmp_path / "fail_main.py"
+    script.write_text("""
+import time
+
+def work(rank):
+    if rank == 0:
+        raise RuntimeError("boom rank0")
+    time.sleep(600)   # would deadlock join() without teardown
+
+if __name__ == "__main__":
+    from paddle_tpu.distributed.spawn import spawn
+    try:
+        spawn(work, nprocs=2)
+    except RuntimeError as e:
+        assert "boom rank0" in str(e), e
+        print("FAIL_PROPAGATED", flush=True)
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "FAIL_PROPAGATED" in proc.stdout
