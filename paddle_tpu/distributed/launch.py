@@ -94,7 +94,7 @@ def _trainer_env(rank: int, world: int, endpoints: List[str],
     return env
 
 
-def _local_addrs() -> set:
+def _local_addrs(probe_ips=()) -> set:
     addrs = {"127.0.0.1", "localhost"}
     try:
         host = socket.gethostname()
@@ -102,6 +102,15 @@ def _local_addrs() -> set:
         addrs.add(socket.gethostbyname(host))
     except OSError:  # pragma: no cover
         pass
+    # hostname often resolves to 127.0.1.1, not the NIC address in --ips;
+    # the UDP-connect trick reveals the interface used to reach each peer
+    for ip in probe_ips:
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((ip, 9))
+                addrs.add(s.getsockname()[0])
+        except OSError:  # pragma: no cover
+            pass
     return addrs
 
 
@@ -191,7 +200,7 @@ def launch(args=None) -> int:
     if len(pods) == 1:
         pod = pods[0]
     else:
-        local = _local_addrs()
+        local = _local_addrs(probe_ips=ips)
         mine = [p for p in pods if p.addr in local]
         if not mine:
             raise SystemExit(

@@ -91,7 +91,8 @@ class GPipeTrainer:
                  optimizer, loss_fn: Callable, mesh: Mesh,
                  num_microbatches: int = 2, pp_axis: str = "pp",
                  dp_axis: str = "dp", remat: bool = True,
-                 strategy: Optional[DistributedStrategy] = None):
+                 strategy: Optional[DistributedStrategy] = None,
+                 dedupe_head: bool = True):
         if pp_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no '{pp_axis}' axis")
         for lname, l in (("pre", pre), ("post", post), ("block", blocks[0])):
@@ -119,6 +120,11 @@ class GPipeTrainer:
             if dp_axis in mesh.axis_names else 1
         self.num_micro = num_microbatches
         self.remat = remat
+        # shard the head+loss over pp ranks (each rank takes M/S of the
+        # microbatches) instead of every rank computing all M masked —
+        # needs M % S == 0, else the masked fallback runs
+        self.dedupe_head = (dedupe_head and
+                            num_microbatches % mesh.shape[pp_axis] == 0)
         self.num_layers = len(blocks)
         if self.num_layers % self.pp_size:
             raise ValueError(
@@ -197,11 +203,13 @@ class GPipeTrainer:
                                              keepdims=False)
             return _call(self.pre, pre_p, Tensor(x), training=training)
 
-        # shapes only — abstract eval, no extra stage compute emitted
-        h0_aval = jax.eval_shape(
-            lambda: self._stage_fn(slab, pre_fn(0), training)[0])
-        zero = jnp.zeros(h0_aval.shape, h0_aval.dtype)
-        out_buf = jnp.zeros((M,) + h0_aval.shape, h0_aval.dtype)
+        # embed ALL microbatches once, outside the tick loop: the old
+        # per-tick pre call ran the embedding M+S-1 times on every rank
+        pre_emb = jnp.stack([pre_fn(m) for m in range(M)])  # [M, mb, h]
+
+        h0_aval = pre_emb.shape[1:]
+        zero = jnp.zeros(h0_aval, pre_emb.dtype)
+        out_buf = jnp.zeros((M,) + h0_aval, pre_emb.dtype)
 
         def tick(carry, t):
             act, out_buf, aux_acc = carry
@@ -222,42 +230,59 @@ class GPipeTrainer:
                     y, self.pp_axis, [(i, i + 1) for i in range(S - 1)])
             else:
                 y_next = y
-            inj = _call(self.pre, pre_p,
-                        Tensor(jax.lax.dynamic_index_in_dim(
-                            micro_in, jnp.clip(t + 1, 0, M - 1), 0,
-                            keepdims=False)), training=training)
+            inj = jax.lax.dynamic_index_in_dim(
+                pre_emb, jnp.clip(t + 1, 0, M - 1), 0, keepdims=False)
             act = jnp.where(idx == 0, inj, y_next)
             return (act, out_buf, aux_acc), None
 
         # t counts processed ticks: act entering tick t is stage input
         # for microbatch (t - stage); total M + S - 1 ticks
-        init_act = jnp.where(idx == 0, pre_fn(0), zero)
+        init_act = jnp.where(idx == 0, pre_emb[0], zero)
         (act, out_buf, aux_acc), _ = jax.lax.scan(
             tick, (init_act, out_buf, jnp.float32(0.0)),
             jnp.arange(M + S - 1))
 
-        # head + loss on every rank; only the last pp rank's is real
         from .moe import collect_aux_losses
-        losses = []
+
+        def head_loss(h, lab_idx):
+            """post + loss for one microbatch activation h."""
+            out = _call(self.post, post_p, Tensor(h), training=training)
+            out_t = jax.tree_util.tree_map(
+                lambda a: Tensor(a, stop_gradient=True), out)
+            lab = jax.tree_util.tree_map(
+                lambda a: Tensor(jax.lax.dynamic_index_in_dim(
+                    a, lab_idx, 0, keepdims=False)), micro_lab)
+            lab = lab if isinstance(lab, (list, tuple)) else (lab,)
+            l = self.loss_fn(out_t, *lab)
+            return (l.data if isinstance(l, Tensor) else l) \
+                .astype(jnp.float32)
+
+        if self.dedupe_head and S > 1:
+            # head+loss SHARDED over pp: broadcast the finished
+            # activations from the last rank (masked psum = one
+            # all-reduce), each rank computes M/S of the heads — per-rank
+            # head FLOPs drop S-fold vs the masked-everywhere fallback
+            Ms = M // S
+            bcast = jax.lax.psum(
+                jnp.where(idx == S - 1, out_buf,
+                          jnp.zeros_like(out_buf)), self.pp_axis)
+            mine = jax.lax.dynamic_slice_in_dim(bcast, idx * Ms, Ms, 0)
+            acts = [(mine[j], idx * Ms + j) for j in range(Ms)]
+            mask_last = False
+        else:
+            # fallback: every rank runs all M heads, masked to last rank
+            acts = [(out_buf[m], m) for m in range(M)]
+            mask_last = True
         with collect_aux_losses() as post_aux:
-            for m in range(M):
-                out = _call(self.post, post_p, Tensor(out_buf[m]),
-                            training=training)
-                out_t = jax.tree_util.tree_map(
-                    lambda a: Tensor(a, stop_gradient=True), out)
-                lab = jax.tree_util.tree_map(
-                    lambda a: Tensor(a[m]), micro_lab)
-                lab = lab if isinstance(lab, (list, tuple)) else (lab,)
-                l = self.loss_fn(out_t, *lab)
-                losses.append((l.data if isinstance(l, Tensor) else l)
-                              .astype(jnp.float32))
-        local = jnp.stack(losses).mean()
+            losses = [head_loss(h, i) for h, i in acts]
+        local = jnp.stack(losses).sum() / M
         for a in post_aux:
             arr = (a.data if isinstance(a, Tensor) else a)
             local = local + arr.astype(jnp.float32) / M
-        masked = jnp.where(idx == S - 1, local, 0.0)
+        if mask_last:
+            local = jnp.where(idx == S - 1, local, 0.0)
         # block aux: each rank saw every microbatch once -> mean over M
-        return (masked + aux_acc / M) / self.dp_size
+        return (local + aux_acc / M) / self.dp_size
 
     def _build(self, training=True):
         mesh = self.mesh

@@ -40,7 +40,7 @@ class SpawnContext:
         """Wait for all workers; on the FIRST failure terminate the
         survivors (they may be blocked in a collective waiting for the
         dead rank) and re-raise — the reference spawn's watch loop."""
-        deadline = time.time() + timeout if timeout else None
+        deadline = time.time() + timeout if timeout is not None else None
 
         def fail(rank=None, tb=None, codes=None):
             for p in self.processes:

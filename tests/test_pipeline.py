@@ -192,3 +192,70 @@ def test_tied_embeddings_rejected():
     model = GPTForCausalLM(cfg)
     with pytest.raises(ValueError):
         gpt_pipeline_parts(model)
+
+
+def _flops_of(pipe, ids, labels):
+    import jax.numpy as jnp
+    micro_in = pipe._microbatch(ids)
+    micro_lab = pipe._microbatch(labels)
+    step = pipe._build(training=True)
+    c = step.lower(pipe.params, pipe.opt_state,
+                   jnp.asarray(0.1, jnp.float32),
+                   jnp.asarray(1, jnp.int32), micro_in, micro_lab).compile()
+    ca = c.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca["flops"])
+
+
+def _head_pipe(dedupe, M=4, seed=33):
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.models.gpt import gpt_pipeline_parts
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=1024, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=32, use_flash_attention=False,
+                    tie_word_embeddings=False)
+    model = GPTForCausalLM(cfg)
+    pre, blocks, post = gpt_pipeline_parts(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    crit = GPTPretrainingCriterion()
+    mesh = create_mesh({"pp": 4})
+    return GPipeTrainer(pre, blocks, post, opt, lambda o, l: crit(o, l),
+                        mesh=mesh, num_microbatches=M, remat=False,
+                        dedupe_head=dedupe)
+
+
+def test_dedupe_head_cuts_compiled_flops():
+    """VERDICT r2 #9 'Done' criterion: sharding the vocab head over pp
+    ranks cuts compiled FLOPs >=30% vs the masked-everywhere GPipe at
+    pp=4 (head was computed M times per rank, now M/S)."""
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1024, (8, 32)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+    f_masked = _flops_of(_head_pipe(False), ids, labels)
+    f_dedupe = _flops_of(_head_pipe(True), ids, labels)
+    assert f_dedupe < 0.7 * f_masked, (f_dedupe, f_masked)
+
+
+def test_dedupe_head_parity():
+    """Deduped head computes the same losses as the masked fallback."""
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 1024, (8, 32)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+    a = _head_pipe(True, seed=5)
+    b = _head_pipe(False, seed=5)
+    la = [float(a.train_step(ids, labels)) for _ in range(3)]
+    lb = [float(b.train_step(ids, labels)) for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=2e-4, atol=2e-5)
+
+
+def test_dedupe_head_falls_back_when_not_divisible():
+    """M=6 not divisible by pp=4: trainer quietly uses the masked head."""
+    pipe = _head_pipe(True, M=6, seed=9)
+    assert not pipe.dedupe_head
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 1024, (12, 32)).astype(np.int32)
+    loss = float(pipe.train_step(ids, np.roll(ids, -1, 1).astype(np.int64)))
+    assert np.isfinite(loss)
