@@ -27,3 +27,8 @@ from .moe import (  # noqa: F401
 from . import ring_attention as ring_attention_mod  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_attention_local, sequence_parallel_attention)
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    save_trainer, load_trainer, latest_checkpoint)
+from . import launch as launch_mod  # noqa: F401
+from .spawn import spawn  # noqa: F401

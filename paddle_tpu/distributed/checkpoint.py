@@ -1,0 +1,127 @@
+"""Trainer checkpoint / auto-resume.
+
+Reference: fluid/incubate/checkpoint/auto_checkpoint.py:71
+(AutoCheckpointChecker / train_epoch_range: periodic save of
+persistables + optimizer accumulators + epoch no, auto-restore on
+restart) and fleet.save_persistables; optimizer state in the reference
+lives in scope vars named `param@accumulator`, so checkpoint = save
+persistable vars.
+
+TPU-native: the compiled trainers own sharded device arrays; checkpoint
+= host-gather the pytrees (numpy) + a small metadata dict, restore =
+device_put each leaf back with its recorded NamedSharding. The file is
+a single pickle (the framework's save format, framework/io.py) — the
+shardings themselves are NOT stored, they come from the rebuilt
+trainer, so a checkpoint written on one mesh layout restores onto
+another (e.g. dp8 -> dp4) as long as the model matches.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+__all__ = ["save_trainer", "load_trainer", "latest_checkpoint"]
+
+_FORMAT = "paddle_tpu_trainer_ckpt_v1"
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def save_trainer(trainer, path: str, extra: Optional[dict] = None) -> str:
+    """Persist a trainer's full training state (params + optimizer state
+    + step count + LR-scheduler state [+ gradient-merge buffer])."""
+    from ..optimizer.lr import LRScheduler
+    state = {
+        "format": _FORMAT,
+        "step_count": trainer._step_count,
+        "params": _to_host(trainer.params),
+        "opt_state": _to_host(trainer.opt_state),
+        "extra": extra or {},
+    }
+    if getattr(trainer, "buffers", None):
+        state["buffers"] = _to_host(trainer.buffers)
+    if getattr(trainer, "_grad_buf", None) is not None:
+        state["grad_buf"] = _to_host(trainer._grad_buf)
+    lr = getattr(trainer.optimizer, "_lr", None)
+    if isinstance(lr, LRScheduler):
+        state["lr_scheduler"] = lr.state_dict()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+    os.replace(tmp, path)  # atomic: a killed save never corrupts
+    return path
+
+
+def _restore_tree(host_tree, live_tree, shardings):
+    """device_put each host leaf with the trainer's sharding, verifying
+    structure + shapes against the live state."""
+    h_leaves, h_def = jax.tree_util.tree_flatten(host_tree)
+    l_leaves, l_def = jax.tree_util.tree_flatten(live_tree)
+    if h_def != l_def:
+        raise ValueError(
+            f"checkpoint structure mismatch: {h_def} vs trainer {l_def}")
+    s_leaves = jax.tree_util.tree_flatten(shardings)[0]
+    out = []
+    for h, l, s in zip(h_leaves, l_leaves, s_leaves):
+        if tuple(h.shape) != tuple(l.shape):
+            raise ValueError(
+                f"checkpoint leaf shape {h.shape} != trainer {l.shape}")
+        out.append(jax.device_put(h.astype(l.dtype), s))
+    return jax.tree_util.tree_unflatten(l_def, out)
+
+
+def load_trainer(trainer, path: str) -> dict:
+    """Restore `save_trainer` state into a (re)built trainer; shardings
+    come from the trainer, so the mesh layout may differ from the one
+    that wrote the checkpoint. Returns the 'extra' metadata dict."""
+    from ..optimizer.lr import LRScheduler
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if state.get("format") != _FORMAT:
+        raise ValueError(f"{path} is not a {_FORMAT} checkpoint")
+    trainer.params = _restore_tree(state["params"], trainer.params,
+                                   trainer._param_shardings)
+    trainer.opt_state = _restore_tree(state["opt_state"],
+                                      trainer.opt_state,
+                                      trainer._opt_shardings)
+    if "buffers" in state and getattr(trainer, "buffers", None):
+        trainer.buffers = _restore_tree(state["buffers"], trainer.buffers,
+                                        trainer._buffer_shardings)
+    if "grad_buf" in state and getattr(trainer, "_grad_buf", None) \
+            is not None:
+        trainer._grad_buf = _restore_tree(
+            state["grad_buf"], trainer._grad_buf, trainer._grad_shardings)
+    trainer._step_count = int(state["step_count"])
+    ksteps = getattr(trainer, "k_steps", 1)
+    trainer.optimizer._step_count = trainer._step_count // max(ksteps, 1)
+    lr = getattr(trainer.optimizer, "_lr", None)
+    if isinstance(lr, LRScheduler) and "lr_scheduler" in state:
+        lr.set_state_dict(state["lr_scheduler"])
+    return state.get("extra", {})
+
+
+def latest_checkpoint(directory: str, prefix: str = "ckpt-"):
+    """Newest `{prefix}{step}` file in directory (auto-resume lookup,
+    reference AutoCheckpointChecker.get_range_checkpoint_path)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and not name.endswith(".tmp"):
+            try:
+                step = int(name[len(prefix):])
+            except ValueError:
+                continue
+            if step > best_step:
+                best, best_step = os.path.join(directory, name), step
+    return best

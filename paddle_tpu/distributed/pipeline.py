@@ -149,7 +149,7 @@ class GPipeTrainer:
             "post": {n: jax.device_put(p.data, repl)
                      for n, p in post.named_parameters()},
         }
-        self._param_sharding = {
+        self._param_shardings = {
             "pre": {n: repl for n in self.params["pre"]},
             "blocks": {n: blk_shard for n in self.params["blocks"]},
             "post": {n: repl for n in self.params["post"]},
@@ -164,7 +164,13 @@ class GPipeTrainer:
                 for k, sub in tree.items()}
         self.opt_state = {
             bundle: _st_shard(opt_state[bundle],
-                              self._param_sharding[bundle])
+                              self._param_shardings[bundle])
+            for bundle in opt_state}
+        # opt-state sharding tree mirrors opt_state (checkpoint restore)
+        self._opt_shardings = {
+            bundle: {k: jax.tree_util.tree_map(
+                lambda a, s=self._param_shardings[bundle][k]: s, sub)
+                for k, sub in opt_state[bundle].items()}
             for bundle in opt_state}
         self._blocks_ref = list(blocks)
         self._compiled = None
@@ -330,7 +336,7 @@ class GPipeTrainer:
 
         return jax.jit(
             step,
-            out_shardings=(self._param_sharding, None, None),
+            out_shardings=(self._param_shardings, None, None),
             donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
@@ -363,6 +369,16 @@ class GPipeTrainer:
         self._step_count += 1
         self.optimizer._step_count = self._step_count
         return loss
+
+    # ------------------------------------------------------------------
+    def save(self, path: str, extra=None) -> str:
+        """Checkpoint params + opt state + step (see SpmdTrainer.save)."""
+        from .checkpoint import save_trainer
+        return save_trainer(self, path, extra=extra)
+
+    def load(self, path: str) -> dict:
+        from .checkpoint import load_trainer
+        return load_trainer(self, path)
 
     # ------------------------------------------------------------------
     def sync_to_model(self):

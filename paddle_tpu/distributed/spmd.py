@@ -505,6 +505,19 @@ class SpmdTrainer:
         sd.update({n: Tensor(a) for n, a in self.buffers.items()})
         return sd
 
+    def save(self, path: str, extra=None) -> str:
+        """Checkpoint the full training state (params + opt state + step
+        + LR scheduler [+ grad-merge buffer]) — reference
+        auto_checkpoint.py:71 / fleet.save_persistables."""
+        from .checkpoint import save_trainer
+        return save_trainer(self, path, extra=extra)
+
+    def load(self, path: str) -> dict:
+        """Restore a save() checkpoint; shardings are re-applied from
+        THIS trainer, so the mesh layout may differ from the writer's."""
+        from .checkpoint import load_trainer
+        return load_trainer(self, path)
+
     @property
     def step_executable(self):
         """The underlying compiled step (for introspection/tests)."""

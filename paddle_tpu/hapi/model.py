@@ -189,8 +189,13 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
-        """reference hapi/model.py:1244."""
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            auto_resume=False):
+        """reference hapi/model.py:1244. auto_resume=True (with
+        save_dir) checkpoints the FULL training state under
+        save_dir/auto each save_freq epochs and, on restart, restores
+        the newest one and continues from the next epoch — the
+        reference's auto_checkpoint train_epoch_range semantics."""
         train_loader = self._as_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._as_loader(eval_data, batch_size, False, False,
@@ -201,9 +206,14 @@ class Model:
             steps=self._try_len(train_loader), log_freq=log_freq,
             save_freq=save_freq, save_dir=save_dir, verbose=verbose,
             metrics=self._metrics_names())
+        start_epoch = 0
+        auto_dir = os.path.join(save_dir, "auto") \
+            if (auto_resume and save_dir) else None
+        if auto_dir:
+            start_epoch = self._auto_restore(auto_dir)
         cbks.on_begin("train")
         self.stop_training = False
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
             logs = self._run_one_epoch(train_loader, cbks, "train",
                                        accumulate_grad_batches, num_iters)
@@ -215,11 +225,70 @@ class Model:
                 logs.update({"eval_" + k: v for k, v in eval_logs.items()})
             if save_dir is not None and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, str(epoch)))
+                if auto_dir:
+                    self._auto_save(auto_dir, epoch)
             if self.stop_training:
                 break
         if save_dir is not None:
             self.save(os.path.join(save_dir, "final"))
         cbks.on_end("train")
+
+    # ---- auto checkpoint (reference auto_checkpoint.py:71) ---------------
+    _AUTO_KEEP = 2  # retained snapshots (newest + one fallback)
+
+    def _auto_save(self, auto_dir, epoch):
+        if self.compiled:
+            self._ensure_trainer().save(
+                os.path.join(auto_dir, f"ckpt-{epoch}"),
+                extra={"epoch": epoch})
+        else:
+            # eager: fit already wrote save_dir/{epoch}.pdparams/.pdopt
+            # one line earlier — the auto marker just points at it
+            import json
+            os.makedirs(auto_dir, exist_ok=True)
+            weights = os.path.join(os.path.dirname(auto_dir), str(epoch))
+            tmp = os.path.join(auto_dir, f"ckpt-{epoch}.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"epoch": epoch, "mode": "eager",
+                           "weights": weights}, f)
+            os.replace(tmp, os.path.join(auto_dir, f"ckpt-{epoch}"))
+        self._auto_prune(auto_dir)
+
+    def _auto_prune(self, auto_dir):
+        """Keep only the newest _AUTO_KEEP snapshots (the reference
+        auto_checkpoint retains a bounded set)."""
+        cks = []
+        for name in os.listdir(auto_dir):
+            if name.startswith("ckpt-") and not name.endswith(".tmp"):
+                try:
+                    cks.append((int(name[len("ckpt-"):]), name))
+                except ValueError:
+                    continue
+        for _, name in sorted(cks)[:-self._AUTO_KEEP]:
+            os.remove(os.path.join(auto_dir, name))
+
+    def _auto_restore(self, auto_dir) -> int:
+        import json
+        from ..distributed.checkpoint import latest_checkpoint
+        ck = latest_checkpoint(auto_dir)
+        if ck is None:
+            return 0
+        with open(ck, "rb") as f:
+            is_pickle = f.read(1) == b"\x80"
+        if is_pickle != self.compiled:
+            raise RuntimeError(
+                f"auto checkpoint {ck} was written in "
+                f"{'compiled' if is_pickle else 'eager'} mode but this "
+                f"run is {'compiled' if self.compiled else 'eager'}; "
+                f"prepare() with the same mesh/strategy as the "
+                f"interrupted run (or remove the auto/ directory)")
+        if self.compiled:
+            extra = self._ensure_trainer().load(ck)
+            return int(extra.get("epoch", -1)) + 1
+        with open(ck) as f:
+            meta = json.load(f)
+        self.load(meta["weights"])
+        return int(meta["epoch"]) + 1
 
     def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None):
         from ..profiler import StepTimer

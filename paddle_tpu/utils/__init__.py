@@ -1,0 +1,2 @@
+"""paddle.utils parity (subset)."""
+from . import unique_name  # noqa: F401

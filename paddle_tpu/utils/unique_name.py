@@ -1,0 +1,51 @@
+"""Unique-name scopes.
+
+Reference: python/paddle/utils/unique_name (generate/guard/switch over
+per-prefix counters). Layer/parameter default names (linear_0.w_0 ...)
+come from per-prefix counters in nn.layer_base; `guard()` swaps in a
+fresh counter scope so models built inside it get deterministic names —
+required when a checkpoint written by one process is restored by
+another that has already built other layers (state-dict keys are
+name-based, exactly like the reference's `param@moment` vars).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+__all__ = ["generate", "guard", "switch"]
+
+_generate_counters: Dict[str, int] = {}
+
+
+def generate(key: str) -> str:
+    """reference unique_name.generate: key -> key_0, key_1, ..."""
+    idx = _generate_counters.get(key, 0)
+    _generate_counters[key] = idx + 1
+    return f"{key}_{idx}"
+
+
+def switch(new_counters: Optional[dict] = None):
+    """Swap both the free-generate counters and the Layer naming
+    counters; returns the previous (generate, layer) counter dicts."""
+    from ..nn import layer_base
+    global _generate_counters
+    prev = (_generate_counters, dict(layer_base._layer_name_counters))
+    _generate_counters = new_counters or {}
+    layer_base._layer_name_counters.clear()
+    return prev
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """reference unique_name.guard: fresh name scope inside the
+    context, previous scope restored on exit."""
+    from ..nn import layer_base
+    prev_gen, prev_layer = switch()
+    try:
+        yield
+    finally:
+        global _generate_counters
+        _generate_counters = prev_gen
+        layer_base._layer_name_counters.clear()
+        layer_base._layer_name_counters.update(prev_layer)
