@@ -97,18 +97,25 @@ class enable_grad:
 
 class GradNode:
     """One recorded op application. vjp_fn maps output cotangents ->
-    input cotangents (aligned with `inputs`)."""
+    input cotangents (aligned with `inputs`). `fn`/`raw_args` keep the
+    forward recipe so create_graph=True can RE-derive the vjp through
+    the tape (reference partial_grad_engine.cc re-runs grad ops the
+    same way); the arrays cost nothing extra — the vjp closure already
+    pins the same residuals."""
 
     __slots__ = ("id", "vjp_fn", "inputs", "out_avals", "name", "multi",
-                 "__weakref__")
+                 "fn", "raw_args", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name="", multi=False):
+    def __init__(self, vjp_fn, inputs, out_avals, name="", multi=False,
+                 fn=None, raw_args=None):
         self.id = next(_node_counter)
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor]
         self.out_avals = out_avals  # list[(shape, dtype)]
         self.name = name
         self.multi = multi  # forward returned a tuple/list (even of len 1)
+        self.fn = fn
+        self.raw_args = raw_args
 
     def __repr__(self):
         return f"<GradNode {self.name or 'op'} id={self.id}>"
@@ -166,6 +173,8 @@ def apply(fn, *args, name: str = ""):
             [(getattr(o, "shape", ()), getattr(o, "dtype", None)) for o in outs],
             name=name or getattr(fn, "__name__", ""),
             multi=multi,
+            fn=fn,
+            raw_args=arrs,
         )
         wrapped = tuple(
             Tensor(o, stop_gradient=False, _creator=(node, i))
@@ -183,10 +192,14 @@ def _is_float0(x):
 
 
 def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
-                capture: Optional[dict] = None):
+                capture: Optional[dict] = None, create_graph=False):
     """Core reverse pass. `capture`: id(tensor) -> slot dict to collect grads
     for paddle.grad()-style calls instead of (or in addition to) writing
-    .grad on leaves."""
+    .grad on leaves. With create_graph=True every vjp application is
+    itself recorded through `apply` (re-deriving it from the node's
+    saved forward fn), so the produced gradients carry tape history and
+    can be differentiated again — double grad, reference
+    partial_grad_engine.cc."""
     from .tensor import Tensor
 
     # node -> {out_idx: cotangent}
@@ -209,9 +222,13 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
                 "backward() on a tensor with stop_gradient=True")
         if root._creator is not None:
             node, idx = root._creator
-            push(node, idx, g)
+            push(node, idx, Tensor(g, stop_gradient=True)
+                 if create_graph else g)
         else:
             root._accumulate_grad(g)
+
+    def _arr(x):
+        return x.data if isinstance(x, Tensor) else x
 
     while heap:
         _, node = heapq.heappop(heap)
@@ -221,6 +238,8 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
             c = slots.get(i)
             if c is None:
                 c = jax.numpy.zeros(shape, dtype)
+                if create_graph:
+                    c = Tensor(c, stop_gradient=True)
             elif dtype is not None and getattr(c, "dtype", None) != dtype:
                 # mixed-precision boundary (AMP): downstream ops may have
                 # produced cotangents in their compute dtype; vjp demands
@@ -231,11 +250,15 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
             raise PreconditionNotMetError(
                 "Trying to backward through the graph a second time; "
                 "set retain_graph=True if you need to.")
-        # cotangent structure must mirror the forward output structure
-        # exactly (a 1-element tuple output needs a 1-element tuple cot)
-        out = tuple(cots) if node.multi else cots[0]
-        in_grads = node.vjp_fn(out)
-        if not retain_graph:
+        if create_graph and node.fn is not None:
+            in_grads = _vjp_through_tape(node, cots)
+        else:
+            # cotangent structure must mirror the forward output
+            # structure exactly (1-element tuple output -> 1-element cot)
+            out = tuple(_arr(c) for c in cots) if node.multi \
+                else _arr(cots[0])
+            in_grads = node.vjp_fn(out)
+        if not retain_graph and not create_graph:
             node.vjp_fn = None
         for t, g in zip(node.inputs, in_grads):
             if t is None or t.stop_gradient or _is_float0(g):
@@ -248,9 +271,29 @@ def _run_engine(roots, root_grads, retain_graph=False, accumulate_leaf=True,
                 cnode, cidx = t._creator
                 push(cnode, cidx, g)
                 if retain_all or t._retain_grads:
-                    t._accumulate_grad(g)
+                    t._accumulate_grad(_arr(g))
             elif accumulate_leaf:
-                t._accumulate_grad(g)
+                t._accumulate_grad(_arr(g))
+
+
+def _vjp_through_tape(node, cots):
+    """Re-derive a node's vjp THROUGH `apply` so the produced gradients
+    carry tape history (create_graph=True). The node's original Tensor
+    inputs enter as apply arguments, which is what connects d(grad)/dx
+    to x in the second-order graph."""
+    n_args = len(node.raw_args)
+
+    def vjp_recompute(*flat):
+        args, cot = flat[:n_args], flat[n_args:]
+        _, f_vjp = jax.vjp(node.fn, *args)
+        gs = f_vjp(tuple(cot) if node.multi else cot[0])
+        return tuple(gs)
+
+    ins = [t if t is not None else a
+           for t, a in zip(node.inputs, node.raw_args)]
+    out = apply(vjp_recompute, *ins, *cots,
+                name=(node.name or "op") + "_grad")
+    return out if isinstance(out, tuple) else (out,)
 
 
 def backward(tensor, grad_tensor=None, retain_graph=False):
@@ -273,17 +316,13 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
-    """paddle.grad parity (reference partial_grad_engine.cc). Eager tape
-    supports first-order; for higher-order use the functional API
-    (paddle_tpu.incubate.functional.grad = jax.grad composition).
+    """paddle.grad parity (reference partial_grad_engine.cc:1064).
+    create_graph=True records the backward pass itself on the tape, so
+    the returned gradients can be differentiated again (double grad).
     """
     import jax.numpy as jnp
     from .tensor import Tensor
 
-    if create_graph:
-        raise InvalidArgumentError(
-            "create_graph=True is not supported on the eager tape; use "
-            "paddle_tpu.jit / jax.grad composition for higher-order grads.")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -294,9 +333,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             for o, g in zip(outputs, grad_outputs)
         ]
     capture = {id(t): {} for t in inputs}
-    retain = bool(retain_graph) if retain_graph is not None else False
+    # create_graph implies the graph survives (reference semantics)
+    retain = bool(retain_graph) if retain_graph is not None \
+        else bool(create_graph)
     _run_engine(outputs, grad_outputs, retain_graph=retain,
-                accumulate_leaf=False, capture=capture)
+                accumulate_leaf=False, capture=capture,
+                create_graph=create_graph)
     results = []
     for t in inputs:
         g = capture[id(t)].get("grad")
@@ -304,5 +346,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             raise InvalidArgumentError(
                 "One of the differentiated tensors appears to not have been "
                 "used in the graph; pass allow_unused=True to return None.")
-        results.append(None if g is None else Tensor(g, stop_gradient=True))
+        if g is None:
+            results.append(None)
+        elif create_graph:
+            # keep the tape connection: the grad is itself differentiable
+            results.append(g if isinstance(g, Tensor) else Tensor(g))
+        else:
+            results.append(Tensor(g.data if isinstance(g, Tensor) else g,
+                                  stop_gradient=True))
     return results

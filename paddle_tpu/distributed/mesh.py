@@ -84,3 +84,29 @@ def mesh_guard(mesh: Mesh):
         yield mesh
     finally:
         set_mesh(prev)
+
+
+# The COMPILE mesh is a separate channel set only while a compiled
+# trainer traces its step: layers use it to place sharding constraints
+# on intermediates. It must not be satisfied by a mesh that merely got
+# cached through default_mesh() — eager tape ops also trace (jax.vjp)
+# and would otherwise pick up constraints from an unrelated mesh.
+_compile_mesh: Optional[Mesh] = None
+
+
+def get_compile_mesh() -> Optional[Mesh]:
+    return _compile_mesh
+
+
+@contextlib.contextmanager
+def compile_mesh_guard(mesh: Mesh):
+    """Used by SpmdTrainer around compiled-step calls: publishes the
+    mesh on BOTH channels (ambient get_mesh for e.g. ring attention
+    routing, compile channel for sharding constraints)."""
+    global _compile_mesh
+    prev_c, _compile_mesh = _compile_mesh, mesh
+    with mesh_guard(mesh):
+        try:
+            yield mesh
+        finally:
+            _compile_mesh = prev_c

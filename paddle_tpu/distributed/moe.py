@@ -275,19 +275,22 @@ class MoELayer(Layer):
         return y, aux, zloss
 
     def _constrain(self, arr, spec: PartitionSpec):
-        """Best-effort sharding constraint: applied when the ambient mesh
-        (set by the compiled trainer via mesh_guard while tracing) carries
-        the named axes. Identity outside a mesh — GSPMD propagation from
-        the sharded expert weights still finds the layout — and inside
-        shard_map manual mode, where per-device constraints over a global
-        mesh would be wrong."""
-        mesh = get_mesh()
+        """Best-effort sharding constraint: applied only under the
+        COMPILE mesh a trainer publishes while tracing its step
+        (mesh.compile_mesh_guard) — the ambient default mesh must not
+        leak constraints into eager tape traces. Identity otherwise:
+        GSPMD propagation from the sharded expert weights still finds
+        the layout. Axes that don't divide the dim (ragged batches)
+        drop to replicated, and shard_map manual mode is skipped."""
+        from .mesh import get_compile_mesh
+        mesh = get_compile_mesh()
         if mesh is None or not isinstance(arr, jax.core.Tracer):
             return arr
         if any(_in_shard_map(a) for a in mesh.axis_names):
             return arr
-        names = [a if (a in mesh.axis_names and mesh.shape[a] > 1)
-                 else None for a in spec]
+        names = [a if (a in mesh.axis_names and mesh.shape[a] > 1 and
+                       arr.shape[i] % mesh.shape[a] == 0)
+                 else None for i, a in enumerate(spec)]
         if not any(names):
             return arr
         return jax.lax.with_sharding_constraint(

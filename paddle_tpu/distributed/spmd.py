@@ -38,7 +38,7 @@ from ..func import functional_call
 from ..nn.layer_base import Layer
 from .fleet.strategy import DistributedStrategy
 from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
-                   mesh_guard)
+                   compile_mesh_guard)
 
 __all__ = ["SpmdTrainer", "dp_train_step", "zero_sharding_spec",
            "build_param_specs"]
@@ -423,7 +423,7 @@ class SpmdTrainer:
             step_no = jnp.asarray(self._step_count + 1, jnp.int32)
             # the ambient mesh lets layers place sharding constraints on
             # intermediates (MoE dispatch buffers) while jit traces
-            with mesh_guard(self.mesh):
+            with compile_mesh_guard(self.mesh):
                 res = self._compiled[key](
                     self.params, self.opt_state, self.buffers, lr, step_no,
                     *batch)
@@ -446,7 +446,7 @@ class SpmdTrainer:
                 len(inputs), len(labels))
         if "update" not in self._compiled:
             self._compiled["update"] = self._build_update()
-        with mesh_guard(self.mesh):
+        with compile_mesh_guard(self.mesh):
             self._grad_buf, self.buffers, loss = self._compiled[akey](
                 self.params, self._grad_buf, self.buffers, *batch)
         self._step_count += 1
@@ -466,7 +466,7 @@ class SpmdTrainer:
         key = ("eval", len(inputs))
         if key not in self._compiled:
             self._compiled[key] = self._build_eval(len(inputs))
-        with mesh_guard(self.mesh):
+        with compile_mesh_guard(self.mesh):
             return self._compiled[key](self.params, self.buffers, *batch)
 
     predict_step = eval_step

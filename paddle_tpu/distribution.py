@@ -1,0 +1,148 @@
+"""paddle.distribution parity — Normal / Uniform / Categorical.
+
+Reference: python/paddle/distribution.py (Distribution base:
+sample/entropy/log_prob/probs/kl_divergence; Uniform low/high; Normal
+loc/scale; Categorical logits). TPU-native: functional jax.random keys
+drawn from the framework generator (core.random.next_key), math in
+jnp — every method is traceable so distributions work inside compiled
+programs as well as eagerly.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core import random as prandom
+from .core.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "kl_divergence"]
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        a = x.data
+    else:
+        a = jnp.asarray(x)
+    return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+        or jnp.issubdtype(a.dtype, jnp.integer) else a
+
+
+class Distribution:
+    """Base (reference distribution.py Distribution)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def kl_divergence(self, other) -> Tensor:
+        raise NotImplementedError
+
+    @staticmethod
+    def _extend(shape, base):
+        return tuple(shape) + tuple(base)
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distribution.py Uniform)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        key = prandom.next_key()
+        base = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(key, self._extend(shape, base),
+                               jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (reference distribution.py Normal)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = prandom.next_key()
+        base = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(key, self._extend(shape, base),
+                                jnp.float32)
+        return Tensor(self.loc + eps * self.scale)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) +
+                      jnp.log(self.scale) +
+                      jnp.zeros_like(self.loc))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) -
+                      0.5 * math.log(2 * math.pi))
+
+    def kl_divergence(self, other: "Normal") -> Tensor:
+        # KL(self || other), reference Normal.kl_divergence
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference
+    distribution.py Categorical)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        key = prandom.next_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+
+    def log_prob(self, value):
+        v = jnp.asarray(_arr(value, dtype=jnp.int32), jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_p, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def kl_divergence(self, other: "Categorical") -> Tensor:
+        p = jnp.exp(self._log_p)
+        return Tensor(jnp.sum(p * (self._log_p - other._log_p), axis=-1))
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """paddle.distribution.kl_divergence dispatch."""
+    return p.kl_divergence(q)

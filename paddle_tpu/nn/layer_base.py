@@ -27,6 +27,8 @@ __all__ = ["Layer", "ParamAttr"]
 # per-prefix counters: linear_0, layer_norm_0, linear_1 — reference
 # unique_name semantics, not one global sequence across all classes
 _layer_name_counters: Dict[str, int] = {}
+# namespace prefix set by paddle_tpu.utils.unique_name.guard("ns_")
+_layer_name_prefix: str = ""
 
 
 class ParamAttr:
@@ -78,7 +80,7 @@ class Layer:
         if name_scope is None:
             # paddle-style unique scope (linear_0, linear_1, ...) so
             # default param names are linear_0.w_0 / linear_0.b_0
-            prefix = self.__class__.__name__.lower()
+            prefix = _layer_name_prefix + self.__class__.__name__.lower()
             idx = _layer_name_counters.get(prefix, 0)
             _layer_name_counters[prefix] = idx + 1
             name_scope = f"{prefix}_{idx}"

@@ -10,7 +10,21 @@ from typing import Optional, Sequence
 
 from ..core.dtype import convert_dtype
 
-__all__ = ["InputSpec", "data"]
+from . import control_flow as _cf  # noqa: E402
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+
+class nn:
+    """paddle.static.nn namespace (control-flow surface; reference
+    operators/controlflow/ via fluid/layers/control_flow.py)."""
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
+
+
+__all__ = ["InputSpec", "data", "cond", "while_loop", "case",
+           "switch_case", "nn"]
 
 
 class InputSpec:

@@ -1,0 +1,137 @@
+"""Control-flow op surface.
+
+Reference: paddle/fluid/operators/controlflow/ (conditional_block_op,
+while_op, ...) exposed through fluid/layers/control_flow.py
+(cond/while_loop/case/switch_case). TPU-native: eager calls with
+concrete predicates run plain Python (the reference dygraph behavior);
+under a trace (jit/to_static/compiled trainers) they lower to
+lax.cond / lax.while_loop / lax.switch — XLA's structured control flow,
+the whole reason data-dependent Python branching is banned inside
+compiled programs.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: _arr(x), tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if not isinstance(a, Tensor) else a, tree)
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None, return_names=None):
+    """reference layers/control_flow cond (conditional_block_op). Both
+    branches must return matching structures (same rule as the
+    reference's static mode)."""
+    p = _arr(pred)
+    if not _is_traced(p):
+        return true_fn() if bool(p) else false_fn()
+    out = jax.lax.cond(
+        jnp.asarray(p, bool).reshape(()),
+        lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()),
+        operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
+               is_test=False, name=None):
+    """reference layers/control_flow while_loop (while_op). loop_vars is
+    a list/tuple; body must keep shapes/dtypes fixed (XLA semantics —
+    the reference's LoD growth tricks map to pre-allocated buffers)."""
+    loop_vars = list(loop_vars)
+    traced = any(_is_traced(v) for v in
+                 jax.tree_util.tree_leaves(_unwrap_tree(loop_vars)))
+    if not traced:
+        while bool(_arr(cond_fn(*loop_vars))):
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+        return loop_vars
+
+    def c(vs):
+        return jnp.asarray(_arr(cond_fn(*_wrap_tree(list(vs)))),
+                           bool).reshape(())
+
+    def b(vs):
+        out = body_fn(*_wrap_tree(list(vs)))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap_tree(out))
+
+    res = jax.lax.while_loop(c, b, tuple(_unwrap_tree(loop_vars)))
+    return [t for t in _wrap_tree(list(res))]
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Optional[Callable] = None,
+         name=None):
+    """reference layers/control_flow case: first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+    if not any(_is_traced(p) for p in preds):
+        for p, f in pred_fn_pairs:
+            if bool(_arr(p)):
+                return f()
+        return default()
+    # traced: nested conds, first-match semantics
+    def build(i):
+        if i == len(fns):
+            return default()
+        return cond(preds[i], fns[i], lambda: build(i + 1))
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """reference layers/control_flow switch_case -> lax.switch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) \
+            if not isinstance(branch_fns[0], (tuple, list)) \
+            else sorted((int(k), v) for k, v in branch_fns)
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    idx = _arr(branch_index)
+    # reference semantics: with default=None the LAST branch is the
+    # default — identical in eager and traced modes
+    if default is None:
+        default = fns[-1]
+    if not _is_traced(idx):
+        i = int(idx)
+        for k, f in items:
+            if k == i:
+                return f()
+        return default()
+    # map branch_index -> dense position; unmatched -> default (last)
+    table = jnp.asarray(keys, jnp.int32)
+    pos = jnp.argmax(table == jnp.asarray(idx, jnp.int32))
+    matched = jnp.any(table == jnp.asarray(idx, jnp.int32))
+    dense = [lambda _, f=f: _unwrap_tree(f()) for f in fns]
+    dense.append(lambda _: _unwrap_tree(default()))
+    sel = jnp.where(matched, pos, len(fns))
+    return _wrap_tree(jax.lax.switch(sel, dense, None))

@@ -16,13 +16,15 @@ from typing import Dict, Optional
 __all__ = ["generate", "guard", "switch"]
 
 _generate_counters: Dict[str, int] = {}
+_prefix: str = ""
 
 
 def generate(key: str) -> str:
-    """reference unique_name.generate: key -> key_0, key_1, ..."""
+    """reference unique_name.generate: key -> key_0, key_1, ... with the
+    active guard's namespace prefix applied."""
     idx = _generate_counters.get(key, 0)
     _generate_counters[key] = idx + 1
-    return f"{key}_{idx}"
+    return f"{_prefix}{key}_{idx}"
 
 
 def switch(new_counters: Optional[dict] = None):
@@ -37,15 +39,25 @@ def switch(new_counters: Optional[dict] = None):
 
 
 @contextlib.contextmanager
-def guard(new_generator=None):
+def guard(new_generator: Optional[str] = None):
     """reference unique_name.guard: fresh name scope inside the
-    context, previous scope restored on exit."""
+    context (optionally namespaced by a string prefix, the reference's
+    new_generator), previous scope restored on exit."""
     from ..nn import layer_base
+    global _prefix
+    if new_generator is not None and not isinstance(new_generator, str):
+        raise TypeError("guard(new_generator) takes a str prefix")
     prev_gen, prev_layer = switch()
+    prev_prefix, _prefix = _prefix, (new_generator or "")
+    # layer default names pick the prefix up too, so two guards yield
+    # disjoint state-dict keys
+    layer_base._layer_name_prefix = _prefix
     try:
         yield
     finally:
         global _generate_counters
         _generate_counters = prev_gen
+        _prefix = prev_prefix
+        layer_base._layer_name_prefix = prev_prefix
         layer_base._layer_name_counters.clear()
         layer_base._layer_name_counters.update(prev_layer)

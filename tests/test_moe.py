@@ -334,3 +334,37 @@ def test_moe_trainer_ignores_stale_global_mesh():
     finally:
         set_mesh(None)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_eager_moe_backward_after_default_mesh_pollution():
+    """Full-suite regression: an earlier default_mesh() (hapi strategy-
+    only path) must not leak sharding constraints into the eager tape's
+    vjp trace — batch 2 is not divisible by the cached dp-8 mesh."""
+    from paddle_tpu.distributed.mesh import default_mesh, set_mesh
+    default_mesh()  # caches a dp-8 global mesh
+    try:
+        layer = make_layer(E=4, H=8, F=16)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        y = layer(x)
+        y.sum().backward()
+        assert layer.gate.grad is not None
+    finally:
+        set_mesh(None)
+
+
+def test_moe_trainer_handles_ragged_batch():
+    """Batch not divisible by dp: the dispatch constraint drops to
+    replicated instead of crashing the compile."""
+    from paddle_tpu.models import GPTPretrainingCriterion
+    crit = GPTPretrainingCriterion()
+    cfg, model = _moe_gpt(seed=13)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l),
+                     mesh=create_mesh({"dp": 8}))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2, 16)).astype(np.int32)  # 2 % 8 != 0
+    loss = float(tr.train_step(ids, ids.astype(np.int64)))
+    assert np.isfinite(loss)
