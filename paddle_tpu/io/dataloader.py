@@ -116,19 +116,132 @@ class _Prefetcher:
             stop_flag[0] = True
 
 
+class _MultiprocessIter:
+    """True multiprocess workers over native shared-memory rings
+    (reference fluid/dataloader/dataloader_iter.py:230-378 +
+    imperative/data_loader.cc): worker process w collates batches
+    w, w+W, ... and pushes pickled frames into ITS ring
+    (io/native/shm_ring.c); the trainer pops ring seq % W, so original
+    batch order is preserved with no reorder buffer and no Python queue
+    locks on the hot path.
+
+    FORK CAVEAT (same as the reference's fork workers): the child is a
+    fork of a process whose JAX runtime is multithreaded, so dataset
+    __getitem__ / collate_fn / worker_init_fn must stay numpy-only —
+    touching jax/paddle Tensors in a worker can deadlock on inherited
+    locks. A dead worker is detected by liveness polling and surfaces
+    as a RuntimeError rather than a hang."""
+
+    def __init__(self, loader, batch_lists, num_workers, capacity_bytes,
+                 timeout_ms, worker_init_fn=None):
+        self.loader = loader
+        self.batch_lists = batch_lists
+        self.num_workers = num_workers
+        self.capacity = capacity_bytes
+        self.timeout_ms = timeout_ms
+        self.worker_init_fn = worker_init_fn
+
+    def __iter__(self):
+        import multiprocessing as mp
+        import pickle
+
+        from .shm_ring import RingClosed, RingTimeout, ShmRing
+
+        ctx = mp.get_context("fork")
+        W = self.num_workers
+        rings = [ShmRing.create(self.capacity) for _ in range(W)]
+        ds, collate = self.loader.dataset, self.loader.collate_fn
+        init_fn = self.worker_init_fn
+
+        def work(w, ring_name, batches):
+            ring = ShmRing.attach(ring_name)
+            try:
+                if init_fn is not None:
+                    init_fn(w)
+                for idxs in batches:
+                    payload = pickle.dumps(
+                        ("b", collate([ds[i] for i in idxs])),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+                    ring.push(payload)
+            except Exception:
+                import traceback
+                try:
+                    ring.push(pickle.dumps(
+                        ("e", traceback.format_exc())))
+                except Exception:
+                    pass
+            finally:
+                ring.close_writer()
+
+        procs = [ctx.Process(target=work,
+                             args=(w, rings[w].name,
+                                   self.batch_lists[w::W]),
+                             daemon=True)
+                 for w in range(W)]
+        for p in procs:
+            p.start()
+        def pop_watched(seq):
+            """Pop with liveness polling: a SIGKILLed worker (OOM) never
+            runs close_writer, so an unbounded pop would hang silently —
+            poll in slices and check the process between them."""
+            import time as _time
+            w = seq % W
+            budget = self.timeout_ms
+            deadline = (_time.monotonic() + budget / 1000.0) \
+                if budget and budget > 0 else None
+            while True:
+                try:
+                    return rings[w].pop(timeout_ms=500)
+                except RingTimeout:
+                    if not procs[w].is_alive():
+                        raise RuntimeError(
+                            f"dataloader worker {w} died before "
+                            f"producing batch {seq} (exitcode "
+                            f"{procs[w].exitcode})")
+                    if deadline and _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"dataloader worker {w} timed out")
+
+        try:
+            for seq in range(len(self.batch_lists)):
+                try:
+                    kind, payload = pickle.loads(pop_watched(seq))
+                except RingClosed:
+                    raise RuntimeError(
+                        f"dataloader worker {seq % W} exited before "
+                        f"producing batch {seq} (exitcode "
+                        f"{procs[seq % W].exitcode})")
+                if kind == "e":
+                    raise RuntimeError(
+                        f"dataloader worker {seq % W} failed:\n{payload}")
+                yield payload
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(5)
+            for r in rings:
+                r.destroy()
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, shm_ring_capacity=32 << 20):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.shm_ring_capacity = shm_ring_capacity
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_sampler = None
@@ -187,8 +300,26 @@ class DataLoader:
         return ((lambda idxs=idxs: collate([ds[i] for i in idxs]))
                 for idxs in self.batch_sampler)
 
+    def _can_multiprocess(self) -> bool:
+        if (self.num_workers <= 0 or not self.use_shared_memory or
+                self._iterable_ds or self.batch_sampler is None):
+            return False
+        import multiprocessing as mp
+        if "fork" not in mp.get_all_start_methods():
+            return False  # pragma: no cover (non-POSIX)
+        from .shm_ring import available
+        return available()
+
     def __iter__(self):
-        if self.num_workers > 0 and self.use_buffer_reader:
+        if self._can_multiprocess():
+            mp_iter = _MultiprocessIter(
+                self, list(self.batch_sampler), self.num_workers,
+                self.shm_ring_capacity,
+                int(self.timeout * 1000) if self.timeout else -1,
+                self.worker_init_fn)
+            for collated in mp_iter:
+                yield self._to_tensors(collated)
+        elif self.num_workers > 0 and self.use_buffer_reader:
             prefetcher = _Prefetcher(
                 self._batch_thunks, self.num_workers,
                 capacity=self.prefetch_factor * max(1, self.num_workers))

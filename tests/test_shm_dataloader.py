@@ -1,0 +1,215 @@
+"""Native shared-memory ring + multiprocess DataLoader tests.
+
+Reference role: operators/reader/buffered_reader.cc +
+fluid/dataloader/dataloader_iter.py:230-378 (multiprocess workers over
+shared memory) + mmap_allocator.cc — here a C11-atomics SPSC ring
+(io/native/shm_ring.c, compiled on demand) under fork workers.
+"""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.shm_ring import (RingClosed, RingTimeout, ShmRing,
+                                    available)
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="no C compiler for the native ring")
+
+
+def test_ring_roundtrip_and_order():
+    r = ShmRing.create(1 << 16)
+    try:
+        msgs = [os.urandom(n) for n in (1, 7, 8, 100, 4096)]
+        for m in msgs:
+            r.push(m)
+        for m in msgs:
+            assert r.pop() == m
+    finally:
+        r.destroy()
+
+
+def test_ring_wraparound_small_capacity():
+    """Capacity forces many wraps; every frame must survive intact."""
+    r = ShmRing.create(1 << 10)  # 1 KiB
+    try:
+        rng = np.random.RandomState(0)
+        produced = []
+        for i in range(200):
+            m = bytes(rng.bytes(int(rng.randint(1, 200))))
+            produced.append(m)
+        # interleave: keep at most 3 in flight
+        got = []
+        k = 0
+        for m in produced:
+            r.push(m, timeout_ms=2000)
+            if len(produced) - len(got) > 3:
+                got.append(r.pop(timeout_ms=2000))
+        while len(got) < len(produced):
+            got.append(r.pop(timeout_ms=2000))
+        assert got == produced
+    finally:
+        r.destroy()
+
+
+def test_ring_close_semantics():
+    r = ShmRing.create(1 << 12)
+    try:
+        r.push(b"last")
+        r.close_writer()
+        assert r.pop() == b"last"       # drain after close
+        with pytest.raises(RingClosed):
+            r.pop()
+        with pytest.raises(RingClosed):
+            r.push(b"nope")
+    finally:
+        r.destroy()
+
+
+def test_ring_pop_timeout():
+    r = ShmRing.create(1 << 12)
+    try:
+        with pytest.raises(RingTimeout):
+            r.pop(timeout_ms=50)
+    finally:
+        r.destroy()
+
+
+def test_ring_cross_process():
+    """Producer in a real child process, consumer here."""
+    r = ShmRing.create(1 << 20)
+
+    def produce(name):
+        w = ShmRing.attach(name)
+        for i in range(50):
+            w.push(bytes([i]) * (i + 1))
+        w.close_writer()
+
+    p = mp.get_context("fork").Process(target=produce, args=(r.name,))
+    p.start()
+    try:
+        for i in range(50):
+            assert r.pop(timeout_ms=10000) == bytes([i]) * (i + 1)
+        with pytest.raises(RingClosed):
+            r.pop(timeout_ms=10000)
+    finally:
+        p.join(10)
+        r.destroy()
+
+
+class _SquareDS:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return (np.full((3, 4), i, np.float32),
+                np.array([i * i], np.int64))
+
+
+def _collect(loader):
+    out = []
+    for x, y in loader:
+        out.append((np.asarray(x.data), np.asarray(y.data)))
+    return out
+
+
+def test_multiprocess_loader_matches_single():
+    ds = _SquareDS()
+    ref = _collect(DataLoader(ds, batch_size=5, shuffle=False,
+                              num_workers=0))
+    got = _collect(DataLoader(ds, batch_size=5, shuffle=False,
+                              num_workers=3, use_shared_memory=True))
+    assert len(got) == len(ref)
+    for (xa, ya), (xb, yb) in zip(ref, got):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_multiprocess_loader_worker_init_and_error():
+    class Bad:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("sample 5 corrupt")
+            return np.zeros(2, np.float32)
+
+    loader = DataLoader(Bad(), batch_size=2, shuffle=False, num_workers=2,
+                        use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="sample 5 corrupt"):
+        _collect_plain(loader)
+
+
+def _collect_plain(loader):
+    return [np.asarray(b.data) for b in loader]
+
+
+def test_multiprocess_loader_transform_heavy():
+    """Transforms run in the worker PROCESS (CPU parallel, no GIL)."""
+    class Heavy:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            a = rng.randn(64, 64).astype(np.float32)
+            return (a @ a.T).astype(np.float32)
+
+    ref = _collect_plain(DataLoader(Heavy(), batch_size=4, shuffle=False,
+                                    num_workers=0))
+    got = _collect_plain(DataLoader(Heavy(), batch_size=4, shuffle=False,
+                                    num_workers=4,
+                                    use_shared_memory=True))
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ring_rejects_oversized_frame():
+    """Frames > capacity/2 can starve the wrap; must raise, not spin."""
+    r = ShmRing.create(1 << 10)
+    try:
+        with pytest.raises(ValueError, match="half the ring"):
+            r.push(b"x" * 600)
+    finally:
+        r.destroy()
+
+
+def test_dead_worker_detected_not_hang():
+    """SIGKILLed worker (no close_writer) surfaces as RuntimeError via
+    liveness polling instead of hanging the trainer."""
+    import signal
+    import time
+
+    class Slow:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            time.sleep(0.3)
+            return np.zeros(2, np.float32)
+
+    from paddle_tpu.io.dataloader import _MultiprocessIter
+
+    class KillingIter(_MultiprocessIter):
+        pass
+
+    loader = DataLoader(Slow(), batch_size=2, shuffle=False,
+                        num_workers=2, use_shared_memory=True)
+    # drive the internals directly so we can SIGKILL a worker
+    import multiprocessing as mp
+    import pickle
+    mp_iter = _MultiprocessIter(loader, list(loader.batch_sampler), 2,
+                                loader.shm_ring_capacity, -1, None)
+    gen = iter(mp_iter)
+    first = next(gen)          # workers are up and producing
+    import os as _os
+    # kill every child the fork context knows about
+    import multiprocessing.process as _mpp
+    for c in mp.active_children():
+        _os.kill(c.pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="died|exited"):
+        for _ in range(8):
+            next(gen)
