@@ -22,9 +22,10 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-# peak dense bf16 FLOP/s per chip by device kind (public spec sheets)
+# peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+# NB: v5e's headline 394 TFLOPS is the INT8 number; bf16 peak is 197.
 _PEAK_BF16 = {
-    "v5 lite": 394e12, "v5e": 394e12,
+    "v5 lite": 197e12, "v5e": 197e12,
     "v5p": 459e12, "v5": 459e12,
     "v4": 275e12,
     "v6 lite": 918e12, "v6e": 918e12,
@@ -63,8 +64,10 @@ def bench_train(config_name, batch, seq, steps, warmup):
     crit = GPTPretrainingCriterion()
     st = DistributedStrategy()
     st.amp = True                      # bf16 params + activations
-    st.recompute = True                # remat every block
-    model.enable_recompute()
+    st.recompute = True                # remat blocks, selective policy:
+    # save matmul outputs ('dots'), recompute only cheap elementwise ops —
+    # full remat pays the whole forward twice and caps MFU ~2/3
+    st.recompute_configs = {"policy": "dots_no_batch"}
     mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh,
                           strategy=st)
@@ -79,6 +82,23 @@ def bench_train(config_name, batch, seq, steps, warmup):
     loss.block_until_ready()
     log(f"  warmup+compile {time.perf_counter() - t0:.1f}s "
         f"loss={float(loss):.4f}")
+
+    # evidence the Pallas flash kernel engages in THIS compiled step:
+    # pallas kernels lower to tpu custom-calls in the step's HLO
+    flash_in_step = None
+    try:
+        batch_dev = trainer.shard_batch((ids, labels))
+        import jax.numpy as jnp
+        lowered = trainer.step_executable.lower(
+            trainer.params, trainer.opt_state, trainer.buffers,
+            jnp.asarray(1e-4, jnp.float32), jnp.asarray(1, jnp.int32),
+            *batch_dev)
+        txt = lowered.as_text()
+        flash_in_step = ("custom_call" in txt or "custom-call" in txt) \
+            and ("flash" in txt or "tpu_custom_call" in txt)
+        log(f"  flash kernel in step HLO: {flash_in_step}")
+    except Exception as e:
+        log(f"  flash HLO check skipped: {type(e).__name__}: {e}")
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -98,6 +118,8 @@ def bench_train(config_name, batch, seq, steps, warmup):
         "flops_per_token": flops_tok,
         "peak_flops": peak, "mfu": mfu,
         "loss": float(loss),
+        "flash_kernel_in_step": flash_in_step,
+        "remat_policy": "dots_no_batch",
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }
@@ -155,26 +177,44 @@ def main():
         return
 
     if on_tpu:
-        attempts = [("gpt3-350m", 8, 2048, 20, 3),
-                    ("gpt3-125m", 16, 2048, 20, 3),
-                    ("gpt3-125m", 8, 2048, 20, 3)]
+        # sweep: larger batch amortizes non-matmul overheads; keep the
+        # BEST MFU across the candidates that fit in HBM
+        sweep = [("gpt3-350m", 16, 2048, 20, 3),
+                 ("gpt3-350m", 24, 2048, 20, 3),
+                 ("gpt3-350m", 8, 2048, 20, 3)]
+        fallbacks = [("gpt3-125m", 16, 2048, 20, 3),
+                     ("gpt3-125m", 8, 2048, 20, 3)]
     else:
-        attempts = [("gpt3-tiny", 4, 256, 5, 2)]
+        sweep = [("gpt3-tiny", 4, 256, 5, 2)]
+        fallbacks = []
     if os.environ.get("BENCH_CONFIG"):
-        attempts = [(os.environ["BENCH_CONFIG"],
-                     int(os.environ.get("BENCH_BATCH", 8)),
-                     int(os.environ.get("BENCH_SEQ", 2048)), 20, 3)] \
-            + attempts
+        sweep = [(os.environ["BENCH_CONFIG"],
+                  int(os.environ.get("BENCH_BATCH", 8)),
+                  int(os.environ.get("BENCH_SEQ", 2048)), 20, 3)]
+        fallbacks = []
 
     result, last_err = None, None
-    for config_name, batch, seq, steps, warmup in attempts:
+    for config_name, batch, seq, steps, warmup in sweep:
         try:
-            result = bench_train(config_name, batch, seq, steps, warmup)
-            break
-        except Exception as e:  # OOM etc: fall back to a smaller config
+            r = bench_train(config_name, batch, seq, steps, warmup)
+            log(f"  candidate {config_name} b{batch}: "
+                f"MFU {r['mfu'] * 100:.2f}%")
+            if result is None or r["mfu"] > result["mfu"]:
+                result = r
+        except Exception as e:  # OOM etc: skip this point
             last_err = e
             log(f"  {config_name} b{batch} failed: "
                 f"{type(e).__name__}: {str(e)[:300]}")
+    if result is None:
+        for config_name, batch, seq, steps, warmup in fallbacks:
+            try:
+                result = bench_train(config_name, batch, seq, steps,
+                                     warmup)
+                break
+            except Exception as e:
+                last_err = e
+                log(f"  {config_name} b{batch} failed: "
+                    f"{type(e).__name__}: {str(e)[:300]}")
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_err}")
 

@@ -160,7 +160,13 @@ class SpmdTrainer:
                     "strategy.recompute=True but the model has no "
                     "enable_recompute(); wrap blocks with "
                     "paddle_tpu.distributed.recompute(...) instead")
-            model.enable_recompute()
+            # honor recompute_configs['policy'] (selective save-dots etc.);
+            # models that predate the policy kwarg keep working
+            pol = st.recompute_configs.get("policy")
+            try:
+                model.enable_recompute(policy=pol)
+            except TypeError:
+                model.enable_recompute()
 
         # ---- state pytrees (raw arrays keyed by structured name) --------
         self._param_objs = dict(model.named_parameters())

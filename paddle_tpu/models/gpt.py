@@ -304,11 +304,17 @@ class GPTModel(Layer):
                               epsilon=config.layer_norm_epsilon)
         self._recompute = False
 
-    def enable_recompute(self):
+    def enable_recompute(self, policy=None):
         """strategy.recompute hook: remat every block. Applied in
         forward() (not by re-wrapping sublayers) so parameter names —
-        and therefore state dicts/checkpoints — are unchanged."""
+        and therefore state dicts/checkpoints — are unchanged.
+
+        policy: jax.checkpoint_policies name ('dots', 'dots_no_batch',
+        ...) — selective save policies keep matmul outputs and only
+        recompute the cheap elementwise ops, recovering most of the remat
+        FLOPs vs full recompute (None)."""
         self._recompute = True
+        self._recompute_policy = policy
         return self
 
     def forward(self, input_ids, attn_mask=None):
@@ -321,8 +327,9 @@ class GPTModel(Layer):
             if self._recompute and self.training:
                 # mask passed positionally so the checkpointed region
                 # treats it as a traced input
-                x = _rc(blk, x) if attn_mask is None else \
-                    _rc(blk, x, attn_mask)
+                pol = getattr(self, "_recompute_policy", None)
+                x = _rc(blk, x, policy=pol) if attn_mask is None else \
+                    _rc(blk, x, attn_mask, policy=pol)
             else:
                 x = blk(x) if attn_mask is None else blk(x, attn_mask)
         return self.ln_f(x)
@@ -344,8 +351,8 @@ class GPTForCausalLM(Layer):
                 has_bias=False, gather_output=True,
                 axis_name=config.tp_axis)
 
-    def enable_recompute(self):
-        self.gpt.enable_recompute()
+    def enable_recompute(self, policy=None):
+        self.gpt.enable_recompute(policy=policy)
         return self
 
     def forward(self, input_ids, attn_mask=None):
