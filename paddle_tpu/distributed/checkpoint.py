@@ -49,6 +49,8 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None) -> str:
         state["buffers"] = _to_host(trainer.buffers)
     if getattr(trainer, "_grad_buf", None) is not None:
         state["grad_buf"] = _to_host(trainer._grad_buf)
+    if getattr(trainer, "_scaler_state", None) is not None:
+        state["scaler"] = _to_host(trainer._scaler_state)
     lr = getattr(trainer.optimizer, "_lr", None)
     if isinstance(lr, LRScheduler):
         state["lr_scheduler"] = lr.state_dict()
@@ -101,6 +103,11 @@ def load_trainer(trainer, path: str) -> dict:
             is not None:
         trainer._grad_buf = _restore_tree(
             state["grad_buf"], trainer._grad_buf, trainer._grad_shardings)
+    if "scaler" in state and getattr(trainer, "_scaler_state", None) \
+            is not None:
+        trainer._scaler_state = _restore_tree(
+            state["scaler"], trainer._scaler_state,
+            trainer._scaler_shardings)
     trainer._step_count = int(state["step_count"])
     ksteps = getattr(trainer, "k_steps", 1)
     trainer.optimizer._step_count = trainer._step_count // max(ksteps, 1)

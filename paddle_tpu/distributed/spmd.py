@@ -145,12 +145,26 @@ class SpmdTrainer:
             if st.gradient_merge else 1
         self.gm_avg = bool(st.gradient_merge_configs.get("avg", True))
         self.amp_enabled = bool(st.amp)
-        if self.amp_enabled and not st.amp_configs.get("use_bf16", True):
+        # fp16 parity path (reference update_loss_scaling_op.cc +
+        # fluid/dygraph/amp/loss_scaler.py): dynamic loss scaling runs
+        # INSIDE the compiled step as (scale, good, bad) state.  bf16 is
+        # the TPU-native default and needs no scaling.
+        self.fp16_scaling = self.amp_enabled and \
+            not st.amp_configs.get("use_bf16", True)
+        self.amp_dtype = jnp.float16 if self.fp16_scaling else jnp.bfloat16
+        ac = st.amp_configs
+        self._scaler_cfg = {
+            "init_loss_scaling": float(ac.get("init_loss_scaling", 2.**15)),
+            "incr_ratio": float(ac.get("incr_ratio", 2.0)),
+            "decr_ratio": float(ac.get("decr_ratio", 0.5)),
+            "incr_every_n_steps": int(ac.get("incr_every_n_steps", 1000)),
+            "decr_every_n_nan_or_inf": int(
+                ac.get("decr_every_n_nan_or_inf", 2)),
+        }
+        if self.fp16_scaling and self.k_steps > 1:
             raise NotImplementedError(
-                "fp16 AMP (use_bf16=False) needs loss scaling which the "
-                "compiled trainer does not implement yet; use bf16 (the "
-                "TPU-native dtype, no scaling required)")
-        self.amp_dtype = jnp.bfloat16
+                "fp16 loss scaling with gradient_merge (k_steps > 1) is "
+                "not supported; use bf16 AMP or k_steps == 1")
 
         if st.recompute:
             # model must cooperate (wrap blocks in distributed.recompute);
@@ -230,6 +244,29 @@ class SpmdTrainer:
             lambda a, s: jax.device_put(a, s), opt_state,
             self._opt_shardings)
 
+        # dynamic loss-scale state lives on-device so the whole
+        # scale/unscale/check/update state machine compiles into the step
+        self._scaler_state = None
+        if self.fp16_scaling:
+            self._scaler_state = {
+                "scale": jax.device_put(jnp.asarray(
+                    self._scaler_cfg["init_loss_scaling"], jnp.float32),
+                    self._repl),
+                "good": jax.device_put(jnp.asarray(0, jnp.int32),
+                                       self._repl),
+                "bad": jax.device_put(jnp.asarray(0, jnp.int32),
+                                      self._repl),
+                # optimizer-visible step count: does NOT advance on
+                # overflow-skipped steps (the reference skips the whole
+                # optimizer call)
+                "t": jax.device_put(jnp.asarray(0, jnp.int32),
+                                    self._repl),
+                "found_inf": jax.device_put(
+                    jnp.asarray(False, jnp.bool_), self._repl),
+            }
+            self._scaler_shardings = {k: self._repl
+                                      for k in self._scaler_state}
+
         # gradient-merge buffer (reference GradMergeAllReduceOpHandle /
         # gradient_merge_optimizer.py): ZeRO stage>=2 shards it over dp
         self._grad_buf = None
@@ -272,7 +309,8 @@ class SpmdTrainer:
             put, batch, is_leaf=lambda x: isinstance(x, Tensor))
 
     # ------------------------------------------------------------------
-    def _loss_and_buffers(self, params, buffers, inputs, labels):
+    def _loss_and_buffers(self, params, buffers, inputs, labels,
+                          scale=None):
         from ..core.autograd import no_grad
         if self.amp_enabled:
             # cast params AND floating inputs: with fp32 activations JAX
@@ -299,21 +337,26 @@ class SpmdTrainer:
         # router load-balance losses (MoE) ride on top of the task loss
         for a in aux:
             loss_arr = loss_arr + (a.data if isinstance(a, Tensor) else a)
-        return loss_arr.astype(jnp.float32), (new_buffers, out)
+        loss32 = loss_arr.astype(jnp.float32)
+        # loss scaling: differentiate the SCALED loss but report the raw
+        # one (reference scale->backward->unscale choreography)
+        scaled = loss32 * scale if scale is not None else loss32
+        return scaled, (new_buffers, out, loss32)
 
     def _grads_fn(self, params, buffers, inputs, labels,
-                  want_outputs=False):
+                  want_outputs=False, scale=None):
         """value_and_grad over trainable params only; frozen params flow
-        as constants."""
+        as constants.  With `scale`, grads come back SCALED (caller
+        unscales after the finite check, like check_finite_and_unscale)."""
         train_p = {n: a for n, a in params.items() if self._trainable[n]}
         frozen_p = {n: a for n, a in params.items()
                     if not self._trainable[n]}
 
         def lfn(tp):
             return self._loss_and_buffers({**tp, **frozen_p}, buffers,
-                                          inputs, labels)
+                                          inputs, labels, scale=scale)
 
-        (loss, (new_buffers, outs)), grads = jax.value_and_grad(
+        (_, (new_buffers, outs, loss)), grads = jax.value_and_grad(
             lfn, has_aux=True)(train_p)
         grads = {n: grads.get(n, jnp.zeros_like(a))
                  for n, a in params.items()}
@@ -334,6 +377,9 @@ class SpmdTrainer:
         """Single-executable step: fwd+bwd+update (k_steps == 1).
         with_outputs additionally returns the forward outputs (hapi needs
         them for metrics; XLA computes them anyway)."""
+        if self.fp16_scaling:
+            return self._build_fused_fp16(n_inputs, n_labels, with_outputs)
+
         def step(params, opt_state, buffers, lr, step_no, *batch):
             inputs, labels = batch[:n_inputs], batch[n_inputs:]
             loss, new_buffers, grads, outs = self._grads_fn(
@@ -353,6 +399,80 @@ class SpmdTrainer:
                      self._buffer_shardings, self._repl)
         if with_outputs:
             shardings = shardings + (None,)  # outputs: let GSPMD place
+        return jax.jit(step, out_shardings=shardings,
+                       donate_argnums=donate)
+
+    def _build_fused_fp16(self, n_inputs, n_labels, with_outputs=False):
+        """fp16 step with in-graph dynamic loss scaling.
+
+        The whole reference choreography — scale the loss, backward,
+        check_finite_and_unscale, conditional optimizer step, scale-state
+        update (/root/reference/paddle/fluid/operators/amp/
+        update_loss_scaling_op.cc, fluid/dygraph/amp/loss_scaler.py:27) —
+        compiles into ONE executable.  Skipping a step is a scalar select
+        (no data-dependent control flow; both branches are cheap since
+        XLA shares the computed update).  The scaler carries its own step
+        counter `t` so Adam bias correction does not advance on skipped
+        steps, matching the reference's skipped optimizer call.
+        """
+        cfg = self._scaler_cfg
+
+        def step(params, opt_state, buffers, scaler, lr, step_no,
+                 *batch):
+            inputs, labels = batch[:n_inputs], batch[n_inputs:]
+            scale = scaler["scale"]
+            loss, new_buffers, grads, outs = self._grads_fn(
+                params, buffers, inputs, labels,
+                want_outputs=with_outputs, scale=scale)
+            inv = (jnp.asarray(1.0, jnp.float32) / scale)
+            grads = {n: g * inv.astype(g.dtype) if _is_floating(g) else g
+                     for n, g in grads.items()}
+            checks = [jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                      for n, g in grads.items()
+                      if self._trainable[n] and _is_floating(g)]
+            found_inf = ~jnp.stack(checks).all() if checks \
+                else jnp.asarray(False)
+            t = jnp.where(found_inf, scaler["t"], scaler["t"] + 1)
+            new_params_u, new_opt_u = self._apply(
+                params, opt_state, grads, lr, t)
+
+            def sel(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(found_inf, b, a), new, old)
+
+            new_params = sel(new_params_u, params)
+            new_opt = sel(new_opt_u, opt_state)
+            # dynamic scale state machine (update_loss_scaling_op.cc):
+            # good-step streak doubles the scale every incr_every_n_steps;
+            # decr_every_n_nan_or_inf consecutive overflows halve it
+            good = jnp.where(found_inf, 0, scaler["good"] + 1)
+            bad = jnp.where(found_inf, scaler["bad"] + 1, 0)
+            incr = good >= cfg["incr_every_n_steps"]
+            decr = bad >= cfg["decr_every_n_nan_or_inf"]
+            new_scale = jnp.where(incr, scale * cfg["incr_ratio"], scale)
+            new_scale = jnp.where(
+                decr, jnp.maximum(scale * cfg["decr_ratio"],
+                                  jnp.asarray(1.0, jnp.float32)),
+                new_scale)
+            good = jnp.where(incr, jnp.asarray(0, jnp.int32), good)
+            bad = jnp.where(decr, jnp.asarray(0, jnp.int32), bad)
+            new_scaler = {"scale": new_scale.astype(jnp.float32),
+                          "good": good.astype(jnp.int32),
+                          "bad": bad.astype(jnp.int32),
+                          "t": t.astype(jnp.int32),
+                          "found_inf": found_inf}
+            merged = dict(buffers)
+            merged.update(new_buffers)
+            if with_outputs:
+                return new_params, new_opt, merged, loss, new_scaler, outs
+            return new_params, new_opt, merged, loss, new_scaler
+
+        donate = (0, 1, 2, 3) if self._donate else ()
+        scaler_sh = dict(self._scaler_shardings)
+        shardings = (self._param_shardings, self._opt_shardings,
+                     self._buffer_shardings, self._repl, scaler_sh)
+        if with_outputs:
+            shardings = shardings + (None,)
         return jax.jit(step, out_shardings=shardings,
                        donate_argnums=donate)
 
@@ -430,10 +550,21 @@ class SpmdTrainer:
             # the ambient mesh lets layers place sharding constraints on
             # intermediates (MoE dispatch buffers) while jit traces
             with compile_mesh_guard(self.mesh):
-                res = self._compiled[key](
-                    self.params, self.opt_state, self.buffers, lr, step_no,
-                    *batch)
-            if return_outputs:
+                if self.fp16_scaling:
+                    res = self._compiled[key](
+                        self.params, self.opt_state, self.buffers,
+                        self._scaler_state, lr, step_no, *batch)
+                else:
+                    res = self._compiled[key](
+                        self.params, self.opt_state, self.buffers, lr,
+                        step_no, *batch)
+            if self.fp16_scaling and return_outputs:
+                (self.params, self.opt_state, self.buffers, loss,
+                 self._scaler_state, outs) = res
+            elif self.fp16_scaling:
+                (self.params, self.opt_state, self.buffers, loss,
+                 self._scaler_state) = res
+            elif return_outputs:
                 (self.params, self.opt_state, self.buffers, loss,
                  outs) = res
             else:
@@ -523,6 +654,20 @@ class SpmdTrainer:
         THIS trainer, so the mesh layout may differ from the writer's."""
         from .checkpoint import load_trainer
         return load_trainer(self, path)
+
+    @property
+    def loss_scale(self):
+        """Current dynamic loss scale (None unless fp16 AMP)."""
+        if self._scaler_state is None:
+            return None
+        return float(self._scaler_state["scale"])
+
+    @property
+    def last_step_skipped(self):
+        """True when the previous fp16 step hit inf/nan and was skipped."""
+        if self._scaler_state is None:
+            return False
+        return bool(self._scaler_state["found_inf"])
 
     @property
     def step_executable(self):
