@@ -42,7 +42,7 @@ def peak_flops(device) -> float:
     return 0.0
 
 
-def bench_train(config_name, batch, seq, steps, warmup):
+def bench_train(config_name, batch, seq, steps, warmup, use_flash=True):
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.distributed import SpmdTrainer, create_mesh
@@ -53,9 +53,10 @@ def bench_train(config_name, batch, seq, steps, warmup):
     from dataclasses import replace
     import jax
 
-    cfg = replace(gpt_configs()[config_name], max_seq_len=seq)
+    cfg = replace(gpt_configs()[config_name], max_seq_len=seq,
+                  use_flash_attention=use_flash)
     log(f"bench: {config_name} seq={seq} batch={batch} "
-        f"({cfg.num_params()/1e6:.0f}M params)")
+        f"flash={use_flash} ({cfg.num_params()/1e6:.0f}M params)")
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -94,8 +95,10 @@ def bench_train(config_name, batch, seq, steps, warmup):
             jnp.asarray(1e-4, jnp.float32), jnp.asarray(1, jnp.int32),
             *batch_dev)
         txt = lowered.as_text()
-        flash_in_step = ("custom_call" in txt or "custom-call" in txt) \
-            and ("flash" in txt or "tpu_custom_call" in txt)
+        # the Pallas kernel lowers to a tpu_custom_call target; the XLA
+        # composite fallback (which also carries 'flash' in op metadata)
+        # and @Sharding custom-calls must NOT satisfy this check
+        flash_in_step = "tpu_custom_call" in txt
         log(f"  flash kernel in step HLO: {flash_in_step}")
     except Exception as e:
         log(f"  flash HLO check skipped: {type(e).__name__}: {e}")
@@ -118,11 +121,43 @@ def bench_train(config_name, batch, seq, steps, warmup):
         "flops_per_token": flops_tok,
         "peak_flops": peak, "mfu": mfu,
         "loss": float(loss),
+        "use_flash": use_flash,
         "flash_kernel_in_step": flash_in_step,
         "remat_policy": "dots_no_batch",
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }
+
+
+def _transient_compile_error(e) -> bool:
+    """Degraded remote-compile service (not a real OOM / shape error)."""
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in (
+        "remote_compile", "HTTP 500", "HTTP 502", "HTTP 503",
+        "tpu_compile_helper", "DEADLINE_EXCEEDED", "UNAVAILABLE",
+        "Connection reset", "Connection refused"))
+
+
+def bench_train_retry(config_name, batch, seq, steps, warmup,
+                      use_flash=True, tries=3):
+    """bench_train with backoff retries on transient compile failures.
+
+    Round 4's number collapsed because every sweep point died on a
+    degraded remote-compile helper (HTTP 500) and there was no retry.
+    """
+    for attempt in range(tries):
+        try:
+            return bench_train(config_name, batch, seq, steps, warmup,
+                               use_flash=use_flash)
+        except Exception as e:
+            if attempt + 1 < tries and _transient_compile_error(e):
+                wait = 20 * (attempt + 1)
+                log(f"  transient compile failure "
+                    f"({type(e).__name__}: {str(e)[:200]}); "
+                    f"retry {attempt + 2}/{tries} in {wait}s")
+                time.sleep(wait)
+                continue
+            raise
 
 
 def bench_flash(seqs=(1024, 2048, 4096)):
@@ -188,35 +223,83 @@ def main():
         sweep = [("gpt3-tiny", 4, 256, 5, 2)]
         fallbacks = []
     if os.environ.get("BENCH_CONFIG"):
+        # an explicit config pins the measurement (the stock sweep does
+        # NOT run); the stock fallbacks still catch a failing request so
+        # the bench always emits a number.  BENCH_ONLY=1 drops even the
+        # fallbacks (probe mode).
         sweep = [(os.environ["BENCH_CONFIG"],
                   int(os.environ.get("BENCH_BATCH", 8)),
                   int(os.environ.get("BENCH_SEQ", 2048)), 20, 3)]
+    if os.environ.get("BENCH_ONLY") == "1":
+        sweep = sweep[:1]
         fallbacks = []
 
-    result, last_err = None, None
+    # MFU below this on real TPU means something is pathological
+    # (degraded compile service / host transfer stall): r4 published
+    # 1.23% without flagging it.  Retry such points and prefer any
+    # healthy result over a pathological one.
+    sanity_floor = 0.08 if on_tpu else 0.0
+
+    result, last_err, candidates = None, None, []
+
+    def consider(r):
+        nonlocal result
+        r["pathological"] = bool(sanity_floor and r["mfu"] < sanity_floor)
+        candidates.append({k: r[k] for k in
+                           ("config", "batch", "use_flash", "mfu",
+                            "step_ms", "pathological")})
+        log(f"  candidate {r['config']} b{r['batch']} "
+            f"flash={r['use_flash']}: MFU {r['mfu'] * 100:.2f}%"
+            + (" [PATHOLOGICAL]" if r["pathological"] else ""))
+        if result is None:
+            result = r
+        elif result["pathological"] and not r["pathological"]:
+            result = r
+        elif r["mfu"] > result["mfu"] and not r["pathological"]:
+            result = r
+
+    sweep_flash = os.environ.get("BENCH_FLASH", "1") != "0"
     for config_name, batch, seq, steps, warmup in sweep:
         try:
-            r = bench_train(config_name, batch, seq, steps, warmup)
-            log(f"  candidate {config_name} b{batch}: "
-                f"MFU {r['mfu'] * 100:.2f}%")
-            if result is None or r["mfu"] > result["mfu"]:
-                result = r
+            consider(bench_train_retry(config_name, batch, seq, steps,
+                                       warmup, use_flash=sweep_flash))
         except Exception as e:  # OOM etc: skip this point
             last_err = e
             log(f"  {config_name} b{batch} failed: "
                 f"{type(e).__name__}: {str(e)[:300]}")
-    if result is None:
-        for config_name, batch, seq, steps, warmup in fallbacks:
+    if result is None or result["pathological"]:
+        # flash kernel itself may be the pathology: try composite path
+        probe = [(s[0], s[1], s[2], s[3], s[4]) for s in sweep[:1]]
+        for config_name, batch, seq, steps, warmup in probe + fallbacks:
             try:
-                result = bench_train(config_name, batch, seq, steps,
-                                     warmup)
-                break
+                consider(bench_train_retry(config_name, batch, seq, steps,
+                                           warmup, use_flash=False))
+                if result is not None and not result["pathological"]:
+                    break
             except Exception as e:
                 last_err = e
-                log(f"  {config_name} b{batch} failed: "
+                log(f"  {config_name} b{batch} (no-flash) failed: "
                     f"{type(e).__name__}: {str(e)[:300]}")
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_err}")
+
+    # flash A/B on the winning config: prove the Pallas kernel's value
+    # (or catch it being slower than the composite) with a real number
+    flash_speedup = None
+    if on_tpu and result["use_flash"] and not result["pathological"]:
+        try:
+            off = bench_train_retry(result["config"], result["batch"],
+                                    result["seq"], max(result["steps"] // 2,
+                                                       5), 2,
+                                    use_flash=False, tries=2)
+            flash_speedup = round(off["step_ms"] / result["step_ms"], 3)
+            log(f"  flash A/B: on {result['step_ms']}ms "
+                f"off {off['step_ms']}ms speedup {flash_speedup}x")
+            if off["mfu"] > result["mfu"]:
+                log("  NOTE: composite beat flash; keeping faster path")
+            consider(off)  # audit trail: the A/B row joins candidates
+        except Exception as e:
+            log(f"  flash A/B skipped: {type(e).__name__}: {str(e)[:200]}")
 
     out = {
         "metric": "gpt_train_mfu",
@@ -227,6 +310,8 @@ def main():
         else 0.0,
     }
     out.update(result)
+    out["flash_speedup"] = flash_speedup
+    out["candidates"] = candidates
     print(json.dumps(out))
 
 

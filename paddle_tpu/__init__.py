@@ -53,13 +53,22 @@ _LAZY_MODULES = (
     "nn", "optimizer", "io", "metric", "amp", "jit", "static",
     "distributed", "vision", "text", "hapi", "callbacks", "profiler",
     "framework", "regularizer", "linalg", "distribution", "incubate",
-    "utils", "models", "autograd", "sparse", "fft", "signal", "onnx_export",
+    "utils", "models", "autograd",
 )
 
 
 def __getattr__(name):
     if name in _LAZY_MODULES:
-        mod = _importlib.import_module(f".{name}", __name__)
+        try:
+            mod = _importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # hasattr()/getattr() probing must see AttributeError for a
+            # MISSING submodule — but a transitive dep failure (e.g. a
+            # broken jax install) must surface as the real import error
+            if e.name != f"{__name__}.{name}":
+                raise
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
     if name == "save":

@@ -1,0 +1,18 @@
+"""paddle.autograd — autodiff entry points on the eager tape.
+
+Reference: the imperative engine surface (backward:
+/root/reference/paddle/fluid/imperative/basic_engine.cc, partial grad:
+partial_grad_engine.cc) exposed in Python as paddle.autograd. Here the
+tape lives in core.autograd; this module is the stable public namespace.
+"""
+from .core.autograd import (  # noqa: F401
+    backward,
+    grad,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad",
+           "set_grad_enabled", "is_grad_enabled"]

@@ -4,9 +4,9 @@ Reference: python/paddle/distributed/spawn.py:276 (spawn: start nprocs
 python processes running func(rank, *args) with the PADDLE_* env set,
 join and re-raise child failures). TPU-native: children rendezvous via
 the JAX coordinator address exported in the env (env.init_parallel_env),
-and each child is pinned to the host-CPU backend by default so
-single-host CPU rings (the reference's localhost test strategy) work
-out of the box.
+and children can be pinned to a specific jax platform via
+spawn(..., backend='cpu') so single-host CPU rings (the reference's
+localhost test strategy) work on machines with one real accelerator.
 """
 from __future__ import annotations
 
@@ -21,10 +21,21 @@ from .launch import find_free_port, trainer_env_vars
 __all__ = ["spawn", "SpawnContext"]
 
 
-def _worker(func, rank, world, coordinator, endpoints, args, err_q):
+def _worker(func, rank, world, coordinator, endpoints, args, err_q,
+            backend):
     try:
         os.environ.update(
             trainer_env_vars(rank, world, endpoints, coordinator))
+        if backend:
+            # pin the child's jax platform BEFORE it imports jax; for
+            # cpu also scrub TPU-plugin env hooks (a sitecustomize keyed
+            # on PALLAS_AXON_* would otherwise bind every child to the
+            # one real TPU chip)
+            os.environ["JAX_PLATFORMS"] = backend
+            if backend == "cpu":
+                for k in list(os.environ):
+                    if k.startswith(("AXON_", "PALLAS_AXON_", "TPU_")):
+                        del os.environ[k]
         func(rank, *args)
     except Exception:
         err_q.put((rank, traceback.format_exc()))
@@ -74,9 +85,15 @@ class SpawnContext:
 
 
 def spawn(func, args: Tuple = (), nprocs: int = 2, join: bool = True,
-          daemon: bool = False, **options):
+          daemon: bool = False, backend: Optional[str] = None,
+          **options):
     """Start `nprocs` processes running func(rank, *args) (reference
-    spawn.py:276). Returns a SpawnContext (join=False) or joins."""
+    spawn.py:276). Returns a SpawnContext (join=False) or joins.
+
+    backend: jax platform to pin the children to (None = inherit the
+    parent's platform selection, matching the reference's behavior).
+    Pass backend='cpu' for single-host CPU rings on a machine with one
+    real accelerator — otherwise every child grabs the same chip."""
     ctx = mp.get_context("spawn")
     err_q = ctx.Queue()
     coordinator = f"127.0.0.1:{find_free_port()}"
@@ -85,7 +102,8 @@ def spawn(func, args: Tuple = (), nprocs: int = 2, join: bool = True,
     for rank in range(nprocs):
         p = ctx.Process(
             target=_worker,
-            args=(func, rank, nprocs, coordinator, endpoints, args, err_q),
+            args=(func, rank, nprocs, coordinator, endpoints, args, err_q,
+                  backend),
             daemon=daemon)
         p.start()
         procs.append(p)

@@ -109,13 +109,17 @@ class SpmdTrainer:
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: Optional[Mesh] = None,
                  strategy: Optional[DistributedStrategy] = None,
-                 dp_axis: str = "dp", donate: bool = True):
+                 dp_axis: str = "dp", sp_axis: Optional[str] = None,
+                 donate: bool = True):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
         self.strategy = strategy or DistributedStrategy()
         self.dp_axis = dp_axis
+        # sequence-parallel axis: explicit arg > model config > "sp"
+        self.sp_axis = sp_axis or getattr(
+            getattr(model, "config", None), "sp_axis", None) or "sp"
         self._donate = donate
         self._step_count = 0
 
@@ -174,12 +178,18 @@ class SpmdTrainer:
                     "strategy.recompute=True but the model has no "
                     "enable_recompute(); wrap blocks with "
                     "paddle_tpu.distributed.recompute(...) instead")
-            # honor recompute_configs['policy'] (selective save-dots etc.);
-            # models that predate the policy kwarg keep working
-            pol = st.recompute_configs.get("policy")
-            try:
+            # honor recompute_configs['policy'] (selective save-dots etc.)
+            # defaulting to 'full' — full-segment remat, matching the
+            # reference's recompute_optimizer (benches opt into selective
+            # policies explicitly); models that predate the policy kwarg
+            # keep working (signature-checked, so a TypeError raised
+            # INSIDE enable_recompute still propagates)
+            import inspect
+            pol = st.recompute_configs.get("policy", "full")
+            sig = inspect.signature(model.enable_recompute)
+            if "policy" in sig.parameters:
                 model.enable_recompute(policy=pol)
-            except TypeError:
+            else:
                 model.enable_recompute()
 
         # ---- state pytrees (raw arrays keyed by structured name) --------
@@ -289,13 +299,15 @@ class SpmdTrainer:
         dims = [self.dp_axis if (self.dp_size > 1 and arr.ndim > 0 and
                                  arr.shape[0] % self.dp_size == 0)
                 else None]
-        # sequence/context parallelism: dim 1 shards over 'sp' (ring
-        # attention consumes the blocks; everything else is GSPMD-local)
-        sp_size = self.mesh.shape.get("sp", 1) \
-            if "sp" in self.mesh.axis_names else 1
+        # sequence/context parallelism: dim 1 shards over the sp axis
+        # (ring attention consumes the blocks; everything else is
+        # GSPMD-local)
+        sp = self.sp_axis
+        sp_size = self.mesh.shape.get(sp, 1) \
+            if sp in self.mesh.axis_names else 1
         if arr.ndim > 1:
-            dims.append("sp" if (sp_size > 1 and
-                                 arr.shape[1] % sp_size == 0) else None)
+            dims.append(sp if (sp_size > 1 and
+                               arr.shape[1] % sp_size == 0) else None)
         dims += [None] * max(0, arr.ndim - len(dims))
         return NamedSharding(self.mesh, PartitionSpec(*dims))
 
@@ -449,7 +461,12 @@ class SpmdTrainer:
             bad = jnp.where(found_inf, scaler["bad"] + 1, 0)
             incr = good >= cfg["incr_every_n_steps"]
             decr = bad >= cfg["decr_every_n_nan_or_inf"]
-            new_scale = jnp.where(incr, scale * cfg["incr_ratio"], scale)
+            # keep the old scale if doubling would overflow fp32 (the
+            # reference op checks IsFinite(new_scale) the same way —
+            # an inf scale would poison every later step)
+            grown = scale * cfg["incr_ratio"]
+            grown = jnp.where(jnp.isfinite(grown), grown, scale)
+            new_scale = jnp.where(incr, grown, scale)
             new_scale = jnp.where(
                 decr, jnp.maximum(scale * cfg["decr_ratio"],
                                   jnp.asarray(1.0, jnp.float32)),

@@ -1,0 +1,9 @@
+"""paddle.incubate — experimental API surface.
+
+Reference: /root/reference/python/paddle/incubate/__init__.py (exposes
+LookAhead + ModelAverage from incubate.optimizer).
+"""
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["optimizer", "LookAhead", "ModelAverage"]

@@ -1,0 +1,79 @@
+"""LookAhead optimizer (k steps forward, 1 step back).
+
+Reference: /root/reference/python/paddle/incubate/optimizer/lookahead.py
+(LookAhead(inner_optimizer, alpha=0.5, k=5): every k inner-optimizer
+steps the slow weights catch up, slow += alpha * (fast - slow), and the
+fast weights restart from the slow ones).
+
+TPU-native shape: the whole rule is part of the pure `_update`, so it
+runs identically in the eager tape path and INSIDE a compiled SpmdTrainer
+step — the slow copy is just one more optimizer-state leaf that shards
+like the parameter (ZeRO-friendly by construction).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError(
+                "inner_optimizer must be a paddle_tpu Optimizer, got "
+                f"{type(inner_optimizer).__name__}")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # take over the inner optimizer's learning rate / clip / decay at
+        # THIS level (the base class applies clip + coupled decay before
+        # _update; the inner _update is called raw, so nothing doubles up)
+        super().__init__(learning_rate=inner_optimizer._lr,
+                         parameters=inner_optimizer._parameters,
+                         weight_decay=None,
+                         grad_clip=inner_optimizer._grad_clip,
+                         name=name)
+        self._weight_decay = inner_optimizer._weight_decay
+        self._lr_scheduler = inner_optimizer._lr_scheduler
+
+    @property
+    def _decoupled_wd(self):
+        return self.inner_optimizer._decoupled_wd
+
+    def _init_accumulators(self, param):
+        accs = self.inner_optimizer._init_accumulators(param)
+        if "slow" in accs:
+            raise RuntimeError(
+                "inner optimizer already has a 'slow' accumulator")
+        # slow weights start at the initial params; materialize a COPY —
+        # aliasing the param buffer breaks compiled trainers that donate
+        # both params and optimizer state to the step executable
+        accs["slow"] = jnp.array(param, copy=True)
+        return accs
+
+    def _update(self, p, g, state, lr, step):
+        inner_state = {n: a for n, a in state.items() if n != "slow"}
+        # per-param hooks (AdamW apply_decay_param_fun etc.) must see the
+        # same context in the inner rule
+        self.inner_optimizer._cur_param_name = self._cur_param_name
+        self.inner_optimizer._cur_param = self._cur_param
+        fast, new_inner = self.inner_optimizer._update(
+            p, g, inner_state, lr, step)
+        slow = state["slow"]
+        sync = (step % self.k) == 0
+        caught_up = slow + self.alpha * (fast.astype(slow.dtype) - slow)
+        new_slow = jnp.where(sync, caught_up, slow)
+        new_p = jnp.where(sync, caught_up.astype(fast.dtype), fast)
+        new_inner["slow"] = new_slow
+        return new_p, new_inner
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()

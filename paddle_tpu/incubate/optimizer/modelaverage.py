@@ -1,0 +1,144 @@
+"""ModelAverage — sliding-window parameter averaging for evaluation.
+
+Reference: /root/reference/python/paddle/incubate/optimizer/modelaverage.py
+(window rule) + paddle/fluid/operators/average_accumulates_op.h:80-106
+(the exact accumulator shift rule, reproduced here as pure jnp):
+
+- every step: sum_1 += param; num_updates += 1; num_accumulates += 1
+- every 16384 updates, fold sum_1 into sum_2 (precision: keep any single
+  running sum short)
+- when num_accumulates >= min_average_window and
+  num_accumulates >= min(max_average_window,
+                         num_updates * average_window_rate):
+  discard the old window: sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0;
+  old_num_accumulates = num_accumulates; num_accumulates = 0
+- apply(): param <- (sum_1 + sum_2 + sum_3) /
+                    max(num_accumulates + old_num_accumulates, 1)
+
+The rule is a pure `_update` (jnp.where on traced ints), so it runs in
+eager `step()` and inside compiled steps alike.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["ModelAverage"]
+
+_FOLD_EVERY = 16384  # kMaxNumAccumulates in average_accumulates_op.h
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters,
+                         name=name)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._restore_values = None
+
+    def _init_accumulators(self, param):
+        f32 = jnp.float32
+        return {
+            "sum_1": jnp.zeros(param.shape, f32),
+            "sum_2": jnp.zeros(param.shape, f32),
+            "sum_3": jnp.zeros(param.shape, f32),
+            "num_accumulates": jnp.zeros((), jnp.int32),
+            "old_num_accumulates": jnp.zeros((), jnp.int32),
+            "num_updates": jnp.zeros((), jnp.int32),
+        }
+
+    def _update(self, p, g, state, lr, step):
+        nu = state["num_updates"] + 1
+        na = state["num_accumulates"] + 1
+        ona = state["old_num_accumulates"]
+        s1 = state["sum_1"] + p.astype(jnp.float32)
+        s2, s3 = state["sum_2"], state["sum_3"]
+
+        fold = (nu % _FOLD_EVERY) == 0
+        s2 = jnp.where(fold, s2 + s1, s2)
+        s1 = jnp.where(fold, jnp.zeros_like(s1), s1)
+
+        window = jnp.minimum(
+            jnp.asarray(self.max_average_window, jnp.int32),
+            (nu.astype(jnp.float32) * self.average_window)
+            .astype(jnp.int32))
+        shift = (na >= self.min_average_window) & (na >= window)
+        s3 = jnp.where(shift, s1 + s2, s3)
+        s1 = jnp.where(shift, jnp.zeros_like(s1), s1)
+        s2 = jnp.where(shift, jnp.zeros_like(s2), s2)
+        ona = jnp.where(shift, na, ona)
+        na = jnp.where(shift, jnp.zeros_like(na), na)
+
+        new_state = {"sum_1": s1, "sum_2": s2, "sum_3": s3,
+                     "num_accumulates": na.astype(jnp.int32),
+                     "old_num_accumulates": ona.astype(jnp.int32),
+                     "num_updates": nu.astype(jnp.int32)}
+        return p, new_state  # accumulation never moves the live params
+
+    # ModelAverage accumulates from the params themselves, so (unlike a
+    # real optimizer) it must run even after grads were cleared
+    def step(self):
+        for p in self._parameters or []:
+            if not p.trainable:
+                continue
+            key = p.name
+            if key not in self._accumulators:
+                self._accumulators[key] = self._init_accumulators(p.data)
+            _, self._accumulators[key] = self._update(
+                p.data, None, self._accumulators[key], 0.0,
+                self._step_count + 1)
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, []
+
+    def _averaged(self, p, accs):
+        total = accs["sum_1"] + accs["sum_2"] + accs["sum_3"]
+        count = jnp.maximum(
+            accs["num_accumulates"] + accs["old_num_accumulates"], 1)
+        return (total / count.astype(jnp.float32)).astype(p.data.dtype)
+
+    @contextmanager
+    def apply(self, need_restore: bool = True):
+        """Swap the averaged values into the live parameters (reference
+        ModelAverage.apply context manager)."""
+        if self._restore_values is not None:
+            raise RuntimeError("ModelAverage.apply() calls cannot nest")
+        self._restore_values = {}
+        for p in self._parameters or []:
+            accs = self._accumulators.get(p.name)
+            if accs is None:
+                continue
+            self._restore_values[p.name] = p.data
+            p._data = self._averaged(p, accs)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        """Put the pre-apply() parameter values back."""
+        if self._restore_values is None:
+            return
+        for p in self._parameters or []:
+            if p.name in self._restore_values:
+                p._data = self._restore_values[p.name]
+        self._restore_values = None
+
+    def state_dict(self):
+        sd = {}
+        for pname, accs in self._accumulators.items():
+            for aname, arr in accs.items():
+                sd[f"{pname}@{aname}"] = Tensor(arr)
+        sd["@step_count"] = self._step_count
+        return sd

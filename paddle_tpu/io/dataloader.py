@@ -194,10 +194,16 @@ class _MultiprocessIter:
                     return rings[w].pop(timeout_ms=500)
                 except RingTimeout:
                     if not procs[w].is_alive():
-                        raise RuntimeError(
-                            f"dataloader worker {w} died before "
-                            f"producing batch {seq} (exitcode "
-                            f"{procs[w].exitcode})")
+                        # the worker may have exited cleanly AFTER
+                        # pushing this batch (final-pop race): drain the
+                        # ring once more before declaring it dead
+                        try:
+                            return rings[w].pop(timeout_ms=100)
+                        except RingTimeout:
+                            raise RuntimeError(
+                                f"dataloader worker {w} died before "
+                                f"producing batch {seq} (exitcode "
+                                f"{procs[w].exitcode})")
                     if deadline and _time.monotonic() > deadline:
                         raise RuntimeError(
                             f"dataloader worker {w} timed out")

@@ -390,6 +390,12 @@ def _composite(q, k, v, causal, kv_mask=None):
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, :] > 0, scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    # fully-masked rows: softmax over all-_NEG scores is uniform, but the
+    # Pallas kernel emits exact zeros there (l -> 0 guard) — zero them so
+    # kernel and composite agree bit-for-bit in convention
+    probs = jnp.where(
+        jnp.max(scores, axis=-1, keepdims=True) <= _NEG / 2,
+        jnp.zeros_like(probs), probs)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 

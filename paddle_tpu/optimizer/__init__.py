@@ -4,3 +4,4 @@ from .optimizers import (  # noqa: F401
     SGD, Momentum, Adagrad, RMSProp, Adadelta, Adam, AdamW, Adamax, Lamb,
     Lars)
 from . import lr  # noqa: F401
+from .ema import ExponentialMovingAverage  # noqa: F401

@@ -1,0 +1,138 @@
+"""ExponentialMovingAverage of parameters.
+
+Reference: /root/reference/python/paddle/fluid/optimizer.py:3466
+(ExponentialMovingAverage): EMA_t = decay * EMA_{t-1} + (1-decay) * p_t,
+bias-corrected at apply() time by 1 / (1 - prod of decays) (equals
+1 - decay^t for a constant decay), with the optional thres_steps
+schedule decay_t = min(decay, (1 + t) / (10 + t)).
+
+TPU-native shape: `update_state` is a pure pytree function usable inside
+a jitted train step; the stateful update()/apply()/restore() surface
+matches the reference's dygraph usage.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ExponentialMovingAverage"]
+
+
+class ExponentialMovingAverage:
+    def __init__(self, decay: float = 0.999, thres_steps: bool = False,
+                 parameters=None, name=None):
+        if not 0.0 <= decay < 1.0:
+            raise ValueError(f"decay must be in [0, 1), got {decay}")
+        self._decay = float(decay)
+        # reference thres_steps is a Variable holding the global step; a
+        # boolean flag is the natural eager form (True = schedule on the
+        # EMA's own update count)
+        self._thres_steps = bool(thres_steps)
+        self._parameters = list(parameters) if parameters is not None \
+            else None
+        self._shadow: Dict[str, jax.Array] = {}
+        self._decay_prod: Dict[str, jax.Array] = {}
+        self._t = 0
+        self._restore_values: Optional[dict] = None
+
+    def _current_decay(self, t):
+        if not self._thres_steps:
+            return jnp.asarray(self._decay, jnp.float32)
+        sched = jnp.asarray((1.0 + t) / (10.0 + t), jnp.float32)
+        return jnp.minimum(jnp.asarray(self._decay, jnp.float32), sched)
+
+    # ---- pure functional form (compiled steps) ------------------------
+    def init_state(self, params):
+        """params pytree -> {'shadow': zeros-like pytree,
+        'decay_prod': ones-like scalars, 't': 0}."""
+        return {
+            "shadow": jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), params),
+            "decay_prod": jnp.ones((), jnp.float32),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update_state(self, params, state):
+        """One EMA step over a params pytree — pure, jit-safe."""
+        t = state["t"] + 1
+        d = self._current_decay(t.astype(jnp.float32))
+        shadow = jax.tree_util.tree_map(
+            lambda s, p: d * s + (1.0 - d) * p.astype(jnp.float32),
+            state["shadow"], params)
+        return {"shadow": shadow,
+                "decay_prod": state["decay_prod"] * d,
+                "t": t.astype(jnp.int32)}
+
+    def averaged(self, params, state):
+        """Bias-corrected EMA values: shadow / (1 - prod(decay))."""
+        corr = jnp.maximum(1.0 - state["decay_prod"], 1e-12)
+        return jax.tree_util.tree_map(
+            lambda s, p: (s / corr).astype(p.dtype), state["shadow"],
+            params)
+
+    # ---- eager surface (reference dygraph usage) ----------------------
+    def update(self):
+        if self._parameters is None:
+            raise RuntimeError(
+                "ExponentialMovingAverage constructed without parameters; "
+                "pass parameters=model.parameters() for eager use")
+        self._t += 1
+        d = self._current_decay(float(self._t))
+        for p in self._parameters:
+            s = self._shadow.get(p.name)
+            if s is None:
+                s = jnp.zeros(p.data.shape, jnp.float32)
+                self._decay_prod[p.name] = jnp.ones((), jnp.float32)
+            self._shadow[p.name] = \
+                d * s + (1.0 - d) * p.data.astype(jnp.float32)
+            self._decay_prod[p.name] = self._decay_prod[p.name] * d
+
+    @contextmanager
+    def apply(self, need_restore: bool = True):
+        if self._restore_values is not None:
+            raise RuntimeError("EMA.apply() calls cannot nest")
+        self._restore_values = {}
+        for p in self._parameters or []:
+            s = self._shadow.get(p.name)
+            if s is None:
+                continue
+            self._restore_values[p.name] = p.data
+            corr = jnp.maximum(1.0 - self._decay_prod[p.name], 1e-12)
+            p._data = (s / corr).astype(p.data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._restore_values is None:
+            return
+        for p in self._parameters or []:
+            if p.name in self._restore_values:
+                p._data = self._restore_values[p.name]
+        self._restore_values = None
+
+    def state_dict(self):
+        sd = {f"{n}@ema": Tensor(a) for n, a in self._shadow.items()}
+        sd.update({f"{n}@decay_prod": Tensor(a)
+                   for n, a in self._decay_prod.items()})
+        sd["@t"] = self._t
+        return sd
+
+    def set_state_dict(self, sd):
+        self._t = int(sd.get("@t", 0))
+        for key, val in sd.items():
+            if key == "@t":
+                continue
+            arr = val.data if isinstance(val, Tensor) else jnp.asarray(val)
+            name, kind = key.rsplit("@", 1)
+            if kind == "ema":
+                self._shadow[name] = arr
+            elif kind == "decay_prod":
+                self._decay_prod[name] = arr

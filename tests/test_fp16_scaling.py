@@ -160,8 +160,7 @@ def test_fp16_parity_with_unscaled_reference():
     model2 = nn.Linear(8, 4)
     opt2 = paddle.optimizer.SGD(learning_rate=0.1,
                                 parameters=model2.parameters())
-    st2 = DistributedStrategy()
-    st2.amp = True  # bf16 path has no scaling; use fp16 manual compare
+    # second trainer: fp16 with scale fixed at 1.0 == unscaled fp16
     tr2 = SpmdTrainer(model2, opt2, mse, mesh=create_mesh({"dp": 1}),
                       strategy=_fp16_strategy(init_loss_scaling=1.0))
 

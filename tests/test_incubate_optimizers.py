@@ -1,0 +1,230 @@
+"""LookAhead / ModelAverage / ExponentialMovingAverage.
+
+Reference semantics:
+- LookAhead: /root/reference/python/paddle/incubate/optimizer/lookahead.py
+- ModelAverage window rule:
+  /root/reference/paddle/fluid/operators/average_accumulates_op.h:80
+- EMA: /root/reference/python/paddle/fluid/optimizer.py:3466
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import LookAhead, ModelAverage
+from paddle_tpu.optimizer import ExponentialMovingAverage
+
+
+def make_data(seed=0, n=8):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 4).astype(np.float32),
+            rng.randn(n, 2).astype(np.float32))
+
+
+def mse(out, y):
+    return F.mse_loss(out, y)
+
+
+def train_eager(opt_factory, steps=10, seed=0):
+    paddle.seed(seed)
+    model = nn.Linear(4, 2)
+    opt = opt_factory(model)
+    x, y = make_data()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    for _ in range(steps):
+        loss = mse(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return model, opt
+
+
+def test_lookahead_matches_hand_rolled():
+    """LookAhead(SGD) == manual fast/slow bookkeeping."""
+    k, alpha, lr, steps = 3, 0.4, 0.1, 7
+    model, _ = train_eager(
+        lambda m: LookAhead(paddle.optimizer.SGD(
+            learning_rate=lr, parameters=m.parameters()),
+            alpha=alpha, k=k),
+        steps=steps)
+
+    # manual replica
+    paddle.seed(0)
+    ref = nn.Linear(4, 2)
+    x, y = make_data()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    fast = {n: np.asarray(p.data, np.float64)
+            for n, p in ref.named_parameters()}
+    slow = {n: v.copy() for n, v in fast.items()}
+    for step in range(1, steps + 1):
+        loss = mse(ref(xt), yt)
+        loss.backward()
+        grads = {n: np.asarray(p.grad.data, np.float64)
+                 for n, p in ref.named_parameters()}
+        for n in fast:
+            fast[n] = fast[n] - lr * grads[n]
+            if step % k == 0:
+                slow[n] = slow[n] + alpha * (fast[n] - slow[n])
+                fast[n] = slow[n]
+        # write back so the next forward uses the updated fast weights
+        for n, p in ref.named_parameters():
+            p._data = paddle.to_tensor(
+                fast[n].astype(np.float32)).data
+            p.clear_grad()
+
+    for n, p in model.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.data), fast[n],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_lookahead_wraps_adam_and_converges():
+    model, _ = train_eager(
+        lambda m: LookAhead(paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=m.parameters())),
+        steps=60)
+    x, y = make_data()
+    loss = float(mse(model(paddle.to_tensor(x)), paddle.to_tensor(y)))
+    assert loss < 1.0
+
+
+def test_lookahead_inside_compiled_trainer():
+    """The slow weights are plain optimizer state, so LookAhead runs
+    inside the compiled SpmdTrainer step unchanged."""
+    from paddle_tpu.distributed import SpmdTrainer, create_mesh
+
+    k, alpha, lr, steps = 3, 0.4, 0.1, 7
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(learning_rate=lr,
+                                 parameters=model.parameters())
+    la = LookAhead(inner, alpha=alpha, k=k)
+    tr = SpmdTrainer(model, la, mse, mesh=create_mesh({"dp": 1}))
+    x, y = make_data()
+    for _ in range(steps):
+        tr.train_step(x, y)
+
+    eager_model, _ = train_eager(
+        lambda m: LookAhead(paddle.optimizer.SGD(
+            learning_rate=lr, parameters=m.parameters()),
+            alpha=alpha, k=k),
+        steps=steps)
+    for (n, p), (_, q) in zip(sorted(tr.params.items()),
+                              sorted({n: p.data for n, p in
+                                      eager_model.named_parameters()}
+                                     .items())):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_model_average_window_and_apply():
+    rate, min_w, max_w = 0.5, 2, 4
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    ma = ModelAverage(rate, parameters=model.parameters(),
+                      min_average_window=min_w, max_average_window=max_w)
+    x, y = make_data()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+
+    # hand-rolled replica of average_accumulates_op.h
+    names = [n for n, _ in model.named_parameters()]
+    s1 = {n: 0.0 for n in names}
+    s2 = {n: 0.0 for n in names}
+    s3 = {n: 0.0 for n in names}
+    na = ona = nu = 0
+    history = {n: [] for n in names}
+    for _ in range(6):
+        loss = mse(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        nu += 1
+        na += 1
+        for n, p in model.named_parameters():
+            s1[n] = s1[n] + np.asarray(p.data, np.float64)
+        if na >= min_w and na >= min(max_w, int(nu * rate)):
+            for n in names:
+                s3[n] = s1[n] + s2[n]
+                s1[n], s2[n] = 0.0, 0.0
+            ona, na = na, 0
+    expect = {n: (s1[n] + s2[n] + s3[n]) / max(na + ona, 1)
+              for n in names}
+
+    live = {n: np.asarray(p.data).copy()
+            for n, p in model.named_parameters()}
+    with ma.apply():
+        for n, p in model.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.data), expect[n],
+                                       rtol=1e-5, atol=1e-6)
+    for n, p in model.named_parameters():  # restored after the context
+        np.testing.assert_array_equal(np.asarray(p.data), live[n])
+
+
+def test_ema_bias_corrected():
+    decay = 0.9
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    ema = ExponentialMovingAverage(decay, parameters=model.parameters())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = make_data()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+
+    shadow = {n: 0.0 for n, _ in model.named_parameters()}
+    t = 0
+    for _ in range(5):
+        loss = mse(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ema.update()
+        t += 1
+        for n, p in model.named_parameters():
+            shadow[n] = decay * shadow[n] + \
+                (1 - decay) * np.asarray(p.data, np.float64)
+
+    live = {n: np.asarray(p.data).copy()
+            for n, p in model.named_parameters()}
+    with ema.apply():
+        for n, p in model.named_parameters():
+            np.testing.assert_allclose(
+                np.asarray(p.data), shadow[n] / (1 - decay ** t),
+                rtol=1e-5, atol=1e-6)
+    for n, p in model.named_parameters():
+        np.testing.assert_array_equal(np.asarray(p.data), live[n])
+
+
+def test_ema_functional_form_matches_eager():
+    import jax
+    decay = 0.95
+    paddle.seed(1)
+    model = nn.Linear(4, 2)
+    params = {n: p.data for n, p in model.named_parameters()}
+    ema = ExponentialMovingAverage(decay, parameters=model.parameters())
+    state = ema.init_state(params)
+
+    step = jax.jit(ema.update_state)
+    for i in range(4):
+        bumped = {n: a + 0.1 * (i + 1) for n, a in params.items()}
+        state = step(bumped, state)
+        for n, p in model.named_parameters():
+            p._data = bumped[n]
+        ema.update()
+
+    avg = ema.averaged(params, state)
+    with ema.apply():
+        for n, p in model.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.data),
+                                       np.asarray(avg[n]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_ema_thres_steps_schedule():
+    ema = ExponentialMovingAverage(0.999, thres_steps=True,
+                                   parameters=[])
+    # early steps use (1+t)/(10+t) < 0.999
+    assert float(ema._current_decay(1.0)) == pytest.approx(2 / 11)
+    assert float(ema._current_decay(1e6)) == pytest.approx(0.999)
