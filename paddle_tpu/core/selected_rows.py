@@ -1,0 +1,140 @@
+"""SelectedRows — row-sparse gradients for large-vocab embeddings.
+
+Reference: /root/reference/paddle/fluid/framework/selected_rows.h (the
+(rows, value) pair that lookup_table's backward emits when is_sparse),
+operators/math/selected_rows_functor.cc MergeAdd (unique-ids + row sum),
+and the sparse optimizer functors (adam_op.h SparseAdamFunctor,
+sgd_op.h sparse branch).
+
+TPU-native shape: `rows` [n] int32 + `values` [n, dim] jax arrays.
+merge() is the MergeAdd role — jnp.unique + segment-sum — and produces
+the canonical deduplicated form the sparse optimizer fast paths consume;
+`to_dense()` is a single scatter-add.  The eager embedding op emits one
+of these instead of densifying the full [vocab, dim] table every step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "embedding_sparse"]
+
+
+class SelectedRows:
+    """Row-sparse tensor: values[i] belongs to full row rows[i].
+
+    Rows may repeat (the raw backward emits one entry per looked-up id);
+    merge() deduplicates.  Supports `+` against other SelectedRows
+    (cheap concat, the accumulation path) and against dense arrays.
+    """
+
+    __slots__ = ("rows", "values", "full_shape", "_bw_epoch")
+
+    def __init__(self, rows, values, full_shape):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = values
+        self.full_shape = tuple(full_shape)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"values rows {self.values.shape[0]} != ids "
+                f"{self.rows.shape[0]}")
+        if tuple(self.values.shape[1:]) != self.full_shape[1:]:
+            raise ValueError(
+                f"value row shape {self.values.shape[1:]} != dense row "
+                f"shape {self.full_shape[1:]}")
+
+    # ---- array-protocol bits the autograd engine touches ---------------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return self.full_shape
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.full_shape)
+
+    def is_selected_rows(self) -> bool:
+        return True
+
+    # ---- conversions ----------------------------------------------------
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows (MergeAdd, selected_rows_functor.cc): unique
+        ids + segment-sum of their values."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.full_shape[0])
+        summed = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=uniq.shape[0])
+        # unique() padding (fill_value = vocab) marks unused slots; keep
+        # them — scatter with mode='drop' ignores out-of-range rows
+        return SelectedRows(uniq, summed, self.full_shape)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.full_shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    # ---- arithmetic (gradient accumulation) -----------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.full_shape != self.full_shape:
+                raise ValueError("SelectedRows shape mismatch: "
+                                 f"{self.full_shape} vs {other.full_shape}")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.full_shape)
+        # dense + sparse -> dense
+        return jnp.asarray(other).at[self.rows].add(
+            self.values.astype(other.dtype), mode="drop")
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"full_shape={self.full_shape}, dtype={self.dtype})")
+
+
+def embedding_sparse(x, weight, padding_idx=None):
+    """Eager embedding lookup whose weight gradient is a SelectedRows.
+
+    Reference lookup_table_v2_op.cc with is_sparse=True: forward is the
+    usual gather; backward emits (ids, upstream-grad-rows) instead of
+    scattering into a dense [vocab, dim] zero table.  The tape node is
+    hand-built because jax.vjp can only produce dense cotangents.
+    """
+    from .autograd import GradNode, _grad_enabled
+    from .tensor import Tensor
+
+    ids = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ids = ids.astype(jnp.int32)
+    w_t = weight if isinstance(weight, Tensor) else None
+    w = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    vocab, dim = w.shape
+
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+
+    needs = _grad_enabled() and w_t is not None and not w_t.stop_gradient
+    if not needs:
+        return Tensor(out, stop_gradient=True)
+
+    def vjp_fn(g):
+        rows = ids.reshape(-1)
+        vals = jnp.asarray(g).reshape(-1, dim)
+        if padding_idx is not None:
+            vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+        return (None, SelectedRows(rows, vals, (vocab, dim)))
+
+    node = GradNode(vjp_fn, [None, w_t],
+                    [(tuple(out.shape), out.dtype)],
+                    name="embedding_sparse_grad", multi=False,
+                    fn=None, raw_args=(ids, w))
+    return Tensor(out, stop_gradient=False, _creator=(node, 0))

@@ -135,6 +135,7 @@ class Tensor:
         self._retain_grads = True
 
     def _accumulate_grad(self, g):
+        from .selected_rows import SelectedRows
         # leaf grads live in the leaf's dtype (AMP: ops may run bf16 but a
         # fp32 master param accumulates fp32 grads, like the reference's
         # cast-op backward restoring the source dtype)
@@ -142,10 +143,25 @@ class Tensor:
                 jnp.issubdtype(g.dtype, jnp.floating) and \
                 jnp.issubdtype(self._data.dtype, jnp.floating):
             g = g.astype(self._data.dtype)
-        if self.grad is None:
+        # row-sparse grads (SelectedRows, reference selected_rows.h) stay
+        # sparse as long as every contribution is sparse; any dense
+        # contribution densifies the accumulated grad
+        prev = self.grad
+        if isinstance(g, SelectedRows):
+            if prev is None:
+                self.grad = g
+            elif isinstance(prev, SelectedRows):
+                self.grad = prev + g
+            else:
+                self.grad = Tensor(prev._data + g, stop_gradient=True,
+                                   name=self.name + "@GRAD")
+        elif isinstance(prev, SelectedRows):
+            self.grad = Tensor(prev + g, stop_gradient=True,
+                               name=self.name + "@GRAD")
+        elif prev is None:
             self.grad = Tensor(g, stop_gradient=True, name=self.name + "@GRAD")
         else:
-            self.grad = Tensor(self.grad._data + g, stop_gradient=True,
+            self.grad = Tensor(prev._data + g, stop_gradient=True,
                                name=self.name + "@GRAD")
         # Stamp which backward pass wrote this grad, so each optimizer's
         # minimize() can tell ITS grads are fresh (a global epoch would let

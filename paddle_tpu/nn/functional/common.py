@@ -89,9 +89,14 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """reference lookup_table_v2_op.cc. sparse (SelectedRows grads) is a
-    GPU-memory optimization; on TPU the dense one-hot/gather lowering is
-    what XLA wants, so `sparse` is accepted and ignored."""
+    """reference lookup_table_v2_op.cc. With sparse=True the EAGER weight
+    gradient is a SelectedRows (rows + values) instead of a dense
+    [vocab, dim] table — the reference's is_sparse path.  Inside traced/
+    compiled steps the op is the plain gather either way (XLA fuses the
+    dense scatter-add fine; sparsity is a host-side update optimization)."""
+    if sparse:
+        from ...core.selected_rows import embedding_sparse
+        return embedding_sparse(x, weight, padding_idx)
 
     def fn(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)

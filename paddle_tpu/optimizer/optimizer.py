@@ -95,6 +95,15 @@ class Optimizer:
                 lr, step) -> tuple:
         raise NotImplementedError
 
+    def _update_sparse(self, p, g, state, lr, step) -> tuple:
+        """Row-sparse update (g: merged SelectedRows). Reference: the
+        sparse optimizer functors (sgd_op.h, adam_op.h SparseAdamFunctor)
+        — only SGD/Adam implement them; everything else fails loudly."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support sparse "
+            f"(SelectedRows) gradients; use SGD or Adam, or construct "
+            f"the Embedding with sparse=False")
+
     # ---- decoupled weight decay hook (AdamW/Lamb override) ---------------
     _decoupled_wd = 0.0
 
@@ -113,14 +122,22 @@ class Optimizer:
             raise InvalidArgumentError(
                 "Optimizer constructed without parameters; pass "
                 "parameters=model.parameters() for dygraph use.")
+        from ..core.selected_rows import SelectedRows
         lr = self.get_lr()
         params_grads = [(p, p.grad) for p in self._parameters
                         if p.grad is not None and p.trainable]
+        sparse_pg = [(p, g) for p, g in params_grads
+                     if isinstance(g, SelectedRows)]
+        if sparse_pg:
+            if self._grad_clip is not None:
+                raise NotImplementedError(
+                    "grad_clip with sparse (SelectedRows) gradients is "
+                    "not supported; clip needs the dense grad")
+            params_grads = [(p, g) for p, g in params_grads
+                            if not isinstance(g, SelectedRows)]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
-        for p, g in params_grads:
-            garr = g.data if isinstance(g, Tensor) else g
-            garr = self._apply_decay(p.data, garr, p)
+        for p, g in params_grads + sparse_pg:
             key = p.name
             if key not in self._accumulators:
                 self._accumulators[key] = self._init_accumulators(p.data)
@@ -128,9 +145,22 @@ class Optimizer:
                 if hasattr(p, "optimize_attr") else lr
             self._cur_param_name = key
             self._cur_param = p
-            new_p, new_state = self._update(
-                p.data, garr, self._accumulators[key], plr,
-                self._step_count + 1)
+            if isinstance(g, SelectedRows):
+                if (getattr(p, "regularizer", None) or
+                        self._weight_decay) is not None and \
+                        not self._decoupled_wd:
+                    raise NotImplementedError(
+                        "coupled weight decay with sparse gradients is "
+                        "not supported (the decay term is dense)")
+                new_p, new_state = self._update_sparse(
+                    p.data, g.merge(), self._accumulators[key], plr,
+                    self._step_count + 1)
+            else:
+                garr = g.data if isinstance(g, Tensor) else g
+                garr = self._apply_decay(p.data, garr, p)
+                new_p, new_state = self._update(
+                    p.data, garr, self._accumulators[key], plr,
+                    self._step_count + 1)
             p._data = new_p.astype(p.data.dtype)
             self._accumulators[key] = new_state
         self._step_count += 1

@@ -26,6 +26,13 @@ class SGD(Optimizer):
     def _update(self, p, g, state, lr, step):
         return p - lr * g, state
 
+    def _update_sparse(self, p, g, state, lr, step):
+        """Sparse branch of sgd_op.h: scatter-subtract the touched rows
+        only (identical numerics to dense — untouched rows have zero
+        grad).  Out-of-range rows (merge() padding) are dropped."""
+        return p.at[g.rows].add(
+            (-lr * g.values).astype(p.dtype), mode="drop"), state
+
 
 class Momentum(Optimizer):
     """reference momentum_op (use_nesterov attr)."""
@@ -130,6 +137,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _update(self, p, g, state, lr, step):
         g32 = g.astype(jnp.float32)
@@ -146,6 +154,31 @@ class Adam(Optimizer):
     def _extra_decay(self, new_p, p, lr):
         return new_p
 
+    def _update_sparse(self, p, g, state, lr, step):
+        """SparseAdamFunctor (reference adam_op.h): lazy_mode touches
+        only the looked-up rows — moments and params of untouched rows
+        stay frozen, an O(n_rows · dim) step instead of O(vocab · dim).
+        Non-lazy matches the dense rule exactly (moments decay
+        everywhere), implemented by densifying the grad."""
+        if not self._lazy_mode:
+            return self._update(p, g.to_dense(), state, lr, step)
+        r = g.rows
+        gv = g.values.astype(jnp.float32)
+        m1, m2 = state["moment1"], state["moment2"]
+        # out-of-range rows (merge() padding) gather clamped garbage and
+        # the matching writes are dropped below, so the result is exact
+        m1r = self._beta1 * m1[r] + (1 - self._beta1) * gv
+        m2r = self._beta2 * m2[r] + (1 - self._beta2) * gv * gv
+        bc1 = 1.0 - self._beta1 ** step
+        bc2 = 1.0 - self._beta2 ** step
+        step_size = lr * jnp.sqrt(bc2) / bc1
+        pr = p[r].astype(jnp.float32) - step_size * m1r / (
+            jnp.sqrt(m2r) + self._epsilon)
+        pr = self._extra_decay(pr, p[r], lr)  # AdamW: rows decay lazily
+        new_p = p.at[r].set(pr.astype(p.dtype), mode="drop")
+        return new_p, {"moment1": m1.at[r].set(m1r, mode="drop"),
+                       "moment2": m2.at[r].set(m2r, mode="drop")}
+
 
 class AdamW(Adam):
     """reference adamw logic (python/paddle/optimizer/adamw.py):
@@ -158,7 +191,7 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode=lazy_mode)
         from ..regularizer import L2Decay
         if isinstance(weight_decay, (int, float)):
             self._wd_coeff = float(weight_decay)
