@@ -19,4 +19,5 @@ from .base import (  # noqa: F401
     stop_worker, distributed_optimizer, DistributedOptimizer,
     distributed_model, save_persistables, save_inference_model, minimize)
 from .strategy import DistributedStrategy  # noqa: F401
+from .dgc import DGCMomentum  # noqa: F401
 from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401

@@ -119,7 +119,9 @@ class DistributedOptimizer:
         self.user_defined_strategy = strategy or _user_strategy or \
             DistributedStrategy()
         self._grad_merge_count = 0
+        self._localsgd_count = 0
         self._swap_large_batch_opt()
+        self._swap_dgc_opt()
 
     def _swap_large_batch_opt(self):
         """lamb/lars strategy flags swap the update rule (reference
@@ -145,6 +147,62 @@ class DistributedOptimizer:
                 parameters=inner._parameters,
                 grad_clip=inner._grad_clip)
 
+    def _swap_dgc_opt(self):
+        """strategy.dgc swaps a Momentum inner optimizer for the DGC
+        top-k-compressed one (reference fleet/meta_optimizers/
+        dgc_optimizer.py: DGC applies only to Momentum)."""
+        from ... import optimizer as opt_mod
+        s = self.user_defined_strategy
+        inner = self.inner_opt
+        if not s.dgc:
+            return
+        from .dgc import DGCMomentum
+        if isinstance(inner, DGCMomentum):
+            return
+        if not isinstance(inner, opt_mod.Momentum):
+            raise NotImplementedError(
+                "strategy.dgc requires a Momentum inner optimizer "
+                "(reference dgc_optimizer.py has the same constraint)")
+        cfg = s.dgc_configs
+        self.inner_opt = DGCMomentum(
+            learning_rate=inner._lr,
+            momentum=inner._momentum,
+            parameters=inner._parameters,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=cfg.get("sparsity", [0.999]),
+            min_dgc_size=cfg.get("min_dgc_size", 16384),
+            grad_clip=inner._grad_clip)
+
+    def _localsgd_sync(self):
+        """strategy.localsgd (reference fleet/meta_optimizers/
+        localsgd_optimizer.py:440): every k_steps, replace each rank's
+        params with the cross-rank average — between syncs ranks train
+        fully locally (no per-step grad allreduce)."""
+        from .. import env as _env
+        from ..collective import all_reduce, ReduceOp
+        s = self.user_defined_strategy
+        if s.adaptive_localsgd and not s.localsgd:
+            # adaptive variant (reference adaptive_localsgd_optimizer):
+            # the loss-driven k adaptation is simplified to its
+            # init_k_steps seed — the sync mechanics are identical
+            cfg = s.adaptive_localsgd_configs
+            k = int(cfg.get("init_k_steps", 1))
+            begin = int(cfg.get("begin_step", 1))
+        else:
+            k = int(s.localsgd_configs.get("k_steps", 1))
+            begin = int(s.localsgd_configs.get("begin_step", 1))
+        self._localsgd_count += 1
+        if self._localsgd_count < begin or \
+                (self._localsgd_count - begin) % max(k, 1) != 0:
+            return
+        world = _env.get_world_size()
+        if world <= 1:
+            return
+        for p in self.inner_opt._parameters or []:
+            red = all_reduce(p.data, op=ReduceOp.SUM)
+            p._data = (red / world).astype(p.data.dtype)
+
     def get_lr(self):
         return self.inner_opt.get_lr()
 
@@ -160,6 +218,8 @@ class DistributedOptimizer:
                     if p.grad is not None:
                         p.grad._data = p.grad.data / k
         self.inner_opt.step()
+        if s.localsgd or s.adaptive_localsgd:
+            self._localsgd_sync()
         if s.gradient_merge:
             self.inner_opt.clear_grad()
 

@@ -1,0 +1,115 @@
+"""Deep Gradient Compression momentum optimizer (eager/DDP path).
+
+Reference: python/paddle/fluid/optimizer.py DGCMomentumOptimizer +
+fleet/meta_optimizers/dgc_optimizer.py + paddle/fluid/operators/dgc_op.h.
+The DGC algorithm (Lin et al.): per parameter keep two residuals
+  u <- m * u + g                (momentum correction)
+  v <- v + u                    (gradient accumulation)
+select the top-k entries of |v|; transmit ONLY those (k = (1-sparsity)
+of the elements), zero them out of both residuals, and apply the summed
+sparse gradient with a plain SGD step.  Momentum lives in u — the
+optimizer update itself is momentum-free, exactly the reference split.
+
+TPU-native comm: each rank all_gathers its (indices, values) pair —
+world * 2k numbers instead of n — and scatter-adds the union locally.
+Dense fallbacks: small params (< min_dgc_size, reference uses the same
+cutoff idea) and all params before rampup_begin_step use a fused dense
+allreduce.
+
+Sparsity rampup (dgc_op.h get_period_sparcity): `sparsity` is a
+schedule; step s inside [rampup_begin_step, rampup_begin_step +
+rampup_step) indexes the list proportionally, after which the final
+entry holds.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+from .. import env
+from ..collective import all_gather, all_reduce, ReduceOp
+
+__all__ = ["DGCMomentum"]
+
+
+class DGCMomentum(Optimizer):
+    _accum_names = ("u", "v")
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, rampup_begin_step=0, rampup_step=1,
+                 sparsity: Sequence[float] = (0.999,), min_dgc_size=16384,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        if use_nesterov:
+            raise NotImplementedError(
+                "DGC with Nesterov momentum is not implemented")
+        self._momentum = float(momentum)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.rampup_step = max(int(rampup_step), 1)
+        self.sparsity: List[float] = list(sparsity)
+        self.min_dgc_size = int(min_dgc_size)
+
+    # ---- schedule -------------------------------------------------------
+    def current_sparsity(self, step: int) -> float:
+        """get_period_sparcity: walk the sparsity list over the rampup
+        window, then hold the last value."""
+        if step < self.rampup_begin_step:
+            return 0.0
+        i = (step - self.rampup_begin_step) * len(self.sparsity) \
+            // self.rampup_step
+        return self.sparsity[min(i, len(self.sparsity) - 1)]
+
+    def _use_dgc(self, p, step: int) -> bool:
+        return (step >= self.rampup_begin_step and
+                math.prod(p.shape or (1,)) >= self.min_dgc_size)
+
+    # ---- update ---------------------------------------------------------
+    def _update(self, p, g, state, lr, step):
+        world = env.get_world_size()
+        step = int(step)
+        if not self._use_dgc(p, step):
+            # dense path: plain synchronized momentum (reference keeps
+            # the momentum op for non-DGC params)
+            if world > 1:
+                g = all_reduce(Tensor(g), op=ReduceOp.SUM).data
+            v = self._momentum * state["v"] + g
+            return p - lr * v, {"u": state["u"], "v": v}
+
+        m = self._momentum
+        u = m * state["u"] + g          # momentum correction
+        v = state["v"] + u              # local accumulation
+        n = math.prod(v.shape)
+        sp = self.current_sparsity(step)
+        k = max(1, min(n, int(round(n * (1.0 - sp)))))
+
+        flat = v.reshape(-1)
+        vals, idx = _topk_abs(flat, k)
+        # zero the transmitted entries out of both residuals
+        flat_v = flat.at[idx].set(0.0)
+        flat_u = u.reshape(-1).at[idx].set(0.0)
+
+        if world > 1:
+            all_idx = _as_array(all_gather(idx)).reshape(-1)
+            all_vals = _as_array(all_gather(vals)).reshape(-1)
+        else:
+            all_idx, all_vals = idx, vals
+        g_sync = jnp.zeros_like(flat).at[all_idx].add(all_vals)
+
+        new_p = p - lr * g_sync.reshape(p.shape)
+        return new_p, {"u": flat_u.reshape(p.shape),
+                       "v": flat_v.reshape(p.shape)}
+
+
+def _topk_abs(flat, k):
+    import jax
+    vals_abs, idx = jax.lax.top_k(jnp.abs(flat), k)
+    return flat[idx], idx
+
+
+def _as_array(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)

@@ -47,6 +47,8 @@ _DEFAULTS: Dict[str, Any] = {
                      "epsilon": 0.0, "exclude_from_weight_decay": []},
     "localsgd": False,
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd": False,
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
     "dgc": False,
     "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
                     "sparsity": [0.999]},

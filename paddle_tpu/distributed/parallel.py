@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from . import env
-from .collective import all_reduce, ReduceOp
+from .collective import all_gather, all_reduce, ReduceOp
 from .mesh import Mesh, NamedSharding, PartitionSpec, default_mesh
 
 __all__ = ["DataParallel", "scale_loss", "dp_shard_batch", "param_shardings"]
@@ -40,8 +40,12 @@ def scale_loss(loss):
 class DataParallel(Layer):
     """paddle.DataParallel parity (reference fluid/dygraph/parallel.py:321).
 
-    find_unused_parameters / comm_buffer_size are accepted for API parity;
-    XLA's fused backward makes both moot (no per-bucket scheduling)."""
+    apply_collective_grads fuses dense grads into comm_buffer_size-MB
+    buckets — ONE allreduce per bucket, the reference Reducer's bucket
+    fusion (imperative/reducer.h:48) — and allgathers row-sparse
+    (SelectedRows) grads as (rows, values) pairs like the reference's
+    sparse-var allgather branch.  find_unused_parameters is accepted for
+    API parity (XLA zero-fills unused grads)."""
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
@@ -49,6 +53,7 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.group = group
+        self.comm_buffer_size = comm_buffer_size
         self.find_unused_parameters = find_unused_parameters
 
     def forward(self, *inputs, **kwargs):
@@ -58,12 +63,62 @@ class DataParallel(Layer):
         return scale_loss(loss)
 
     def apply_collective_grads(self):
-        """Allreduce all parameter grads (reference Reducer's job)."""
+        """Bucketed allreduce of all parameter grads (the Reducer's job:
+        reference imperative/reducer.cc groups grads into comm buffers
+        and launches one fused allreduce per bucket)."""
         if env.get_world_size() <= 1:
             return
+        from ..core.selected_rows import SelectedRows
+
+        dense, sparse = [], []
         for p in self._layers.parameters():
-            if p.grad is not None:
-                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
+            if p.grad is None:
+                continue
+            if isinstance(p.grad, SelectedRows):
+                sparse.append(p)
+            else:
+                dense.append(p)
+
+        # sparse grads: allgather (rows, values) across ranks — summing
+        # a SelectedRows is concatenation (merge() dedupes lazily)
+        for p in sparse:
+            g = p.grad
+            rows = all_gather(g.rows, group=self.group)
+            vals = all_gather(g.values, group=self.group)
+            rows = rows.data if isinstance(rows, Tensor) else rows
+            vals = vals.data if isinstance(vals, Tensor) else vals
+            p.grad = SelectedRows(rows.reshape(-1),
+                                  vals.reshape(-1, *g.values.shape[1:]),
+                                  g.full_shape)
+
+        # dense grads: fuse into ~comm_buffer_size MB flat buckets
+        import math
+
+        def flush(bucket):
+            if not bucket:
+                return
+            flat = jnp.concatenate(
+                [b.grad.data.reshape(-1).astype(jnp.float32)
+                 for b in bucket])
+            red = all_reduce(Tensor(flat), op=ReduceOp.SUM,
+                             group=self.group)
+            off = 0
+            for b in bucket:
+                n = max(math.prod(b.grad.data.shape), 1)
+                b.grad._data = red.data[off:off + n].reshape(
+                    b.grad.data.shape).astype(b.grad.data.dtype)
+                off += n
+
+        budget = max(int(self.comm_buffer_size * 1024 * 1024), 1)
+        bucket, used = [], 0
+        for p in dense:
+            nbytes = max(math.prod(p.grad.data.shape), 1) * 4
+            if bucket and used + nbytes > budget:
+                flush(bucket)
+                bucket, used = [], 0
+            bucket.append(p)
+            used += nbytes
+        flush(bucket)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

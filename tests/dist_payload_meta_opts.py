@@ -1,0 +1,108 @@
+"""2-rank payload for the eager meta-optimizers (reference
+test_dist_base.py:668 separate-script pattern): LocalSGD periodic
+averaging, DGC top-k compressed training, and the bucketed DDP reducer
+(multiple buckets + a sparse embedding grad). Each rank prints values
+the parent test compares."""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.core.selected_rows import SelectedRows  # noqa: E402
+from paddle_tpu.distributed import DataParallel, env  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.fleet import (  # noqa: E402
+    DGCMomentum, DistributedStrategy)
+
+
+def run_localsgd(rank):
+    paddle.seed(0)
+    model = nn.Linear(4, 2, bias_attr=False)
+    st = DistributedStrategy()
+    st.localsgd = True
+    st.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=model.parameters()), st)
+    rng = np.random.RandomState(100 + rank)
+    # 5 steps with k=2, begin=1: syncs at steps 1, 3, 5 — the LAST step
+    # is a sync, so both ranks must print identical weights
+    for _ in range(5):
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w = np.asarray(model.weight.data)
+    print(f"LOCALSGD {rank} {float(np.abs(w).sum()):.6f}", flush=True)
+
+
+def run_dgc(rank):
+    paddle.seed(0)
+    model = nn.Linear(8, 4, bias_attr=False)   # 32 elems
+    opt = DGCMomentum(learning_rate=0.02, momentum=0.9,
+                      parameters=model.parameters(),
+                      sparsity=[0.5], min_dgc_size=1)
+    # fixed per-rank batch, SHARED target: descent on the summed
+    # quadratic objective drives the average loss down deterministically
+    rng = np.random.RandomState(200 + rank)
+    x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    tgt = paddle.to_tensor(
+        np.random.RandomState(999).randn(8, 4).astype(np.float32))
+    losses = []
+    for _ in range(6):
+        loss = ((model(x) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    w = np.asarray(model.weight.data)
+    print(f"DGC {rank} {float(np.abs(w).sum()):.6f} "
+          f"{losses[0]:.4f} {losses[-1]:.4f}", flush=True)
+
+
+def run_bucketed_ddp(rank):
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(20, 4, sparse=True)
+            self.fc1 = nn.Linear(4, 16)
+            self.fc2 = nn.Linear(16, 2)
+
+        def forward(self, ids):
+            h = paddle.mean(self.emb(ids), axis=1)
+            return self.fc2(self.fc1(h))
+
+    model = Net()
+    # tiny buffer: every dense grad lands in its own bucket
+    dp = DataParallel(model, comm_buffer_size=1e-6)
+    rng = np.random.RandomState(300 + rank)
+    ids = paddle.to_tensor(rng.randint(0, 20, (4, 3)).astype(np.int64))
+    loss = dp(ids).sum()
+    loss.backward()
+    dp.apply_collective_grads()
+    dense_sum = sum(float(np.asarray(p.grad.data).sum())
+                    for n, p in model.named_parameters()
+                    if not isinstance(p.grad, SelectedRows))
+    emb_g = model.emb.weight.grad
+    assert isinstance(emb_g, SelectedRows), type(emb_g)
+    sparse_sum = float(emb_g.numpy().sum())
+    print(f"DDP {rank} {dense_sum:.6f} {sparse_sum:.6f}", flush=True)
+
+
+def main():
+    env.init_parallel_env()
+    rank, world = env.get_rank(), env.get_world_size()
+    assert world == 2, f"expected 2 ranks, got {world}"
+    run_localsgd(rank)
+    run_dgc(rank)
+    run_bucketed_ddp(rank)
+
+
+if __name__ == "__main__":
+    main()
