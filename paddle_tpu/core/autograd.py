@@ -123,8 +123,14 @@ class GradNode:
 
 def _check_nan_inf(arrs, name):
     # FLAGS_check_nan_inf parity (reference nan_inf_utils_detail.cc:293).
+    # Eager values only: under a jit trace the values are symbolic —
+    # compiled coverage is SpmdTrainer's in-step guard, which returns a
+    # finite-check vector from the executable instead.
     for a in arrs:
-        if hasattr(a, "dtype") and np.issubdtype(np.asarray(a).dtype, np.floating):
+        if isinstance(a, jax.core.Tracer):
+            return
+        if hasattr(a, "dtype") and jax.numpy.issubdtype(
+                a.dtype, jax.numpy.floating):
             if not bool(jax.numpy.isfinite(a).all()):
                 raise EnforceNotMet(
                     f"Operator {name or 'op'} output contains NaN or Inf.")

@@ -170,6 +170,14 @@ class SpmdTrainer:
                 "fp16 loss scaling with gradient_merge (k_steps > 1) is "
                 "not supported; use bf16 AMP or k_steps == 1")
 
+        # FLAGS_check_nan_inf coverage for the COMPILED path (reference
+        # scans every kernel output, nan_inf_utils_detail.cc:293; here
+        # the jitted step returns one bool per checked tensor and the
+        # host raises with the offending names).  Read at build time:
+        # the flag changes the compiled program.
+        from ..core.flags import GLOBAL_FLAGS
+        self._check_nan_inf = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+
         if st.recompute:
             # model must cooperate (wrap blocks in distributed.recompute);
             # raising here beats silently training without remat
@@ -385,6 +393,35 @@ class SpmdTrainer:
         return new_params, new_opt
 
     # ------------------------------------------------------------------
+    def _nanguard_names(self):
+        """Static name list the in-step finite check reports against."""
+        return ["loss"] + [f"{n}@GRAD" for n in sorted(self._trainable)
+                           if self._trainable[n]]
+
+    def _nanguard_vec(self, loss, grads):
+        """One bool per checked tensor: True = contains nan/inf."""
+        flags = [~jnp.isfinite(loss)]
+        for n in sorted(self._trainable):
+            if not self._trainable[n]:
+                continue
+            g = grads[n]
+            if _is_floating(g):
+                flags.append(~jnp.all(jnp.isfinite(
+                    g.astype(jnp.float32))))
+            else:
+                flags.append(jnp.asarray(False))
+        return jnp.stack(flags)
+
+    def _raise_nonfinite(self, vec):
+        import numpy as _np
+        bad = _np.asarray(vec)
+        if bad.any():
+            names = [n for n, b in zip(self._nanguard_names(), bad) if b]
+            from ..core.errors import PreconditionNotMetError
+            raise PreconditionNotMetError(
+                f"FLAGS_check_nan_inf: nan/inf detected in compiled "
+                f"train step: {names}")
+
     def _build_fused(self, n_inputs, n_labels, with_outputs=False):
         """Single-executable step: fwd+bwd+update (k_steps == 1).
         with_outputs additionally returns the forward outputs (hapi needs
@@ -400,9 +437,11 @@ class SpmdTrainer:
                 params, opt_state, grads, lr, step_no)
             merged = dict(buffers)
             merged.update(new_buffers)
+            extra = (self._nanguard_vec(loss, grads),) \
+                if self._check_nan_inf else ()
             if with_outputs:
-                return new_params, new_opt, merged, loss, outs
-            return new_params, new_opt, merged, loss
+                return (new_params, new_opt, merged, loss, outs) + extra
+            return (new_params, new_opt, merged, loss) + extra
 
         donate = (0, 1, 2) if self._donate else ()
         # input shardings come from the committed input arrays (device_put
@@ -411,6 +450,8 @@ class SpmdTrainer:
                      self._buffer_shardings, self._repl)
         if with_outputs:
             shardings = shardings + (None,)  # outputs: let GSPMD place
+        if self._check_nan_inf:
+            shardings = shardings + (self._repl,)
         return jax.jit(step, out_shardings=shardings,
                        donate_argnums=donate)
 
@@ -501,14 +542,17 @@ class SpmdTrainer:
             new_buf = {n: grad_buf[n] + grads[n] for n in grad_buf}
             merged = dict(buffers)
             merged.update(new_buffers)
-            return new_buf, merged, loss
+            extra = (self._nanguard_vec(loss, grads),) \
+                if self._check_nan_inf else ()
+            return (new_buf, merged, loss) + extra
 
         donate = (1, 2) if self._donate else ()
-        return jax.jit(
-            accum,
-            out_shardings=(self._grad_shardings, self._buffer_shardings,
-                           self._repl),
-            donate_argnums=donate)
+        shardings = (self._grad_shardings, self._buffer_shardings,
+                     self._repl)
+        if self._check_nan_inf:
+            shardings = shardings + (self._repl,)
+        return jax.jit(accum, out_shardings=shardings,
+                       donate_argnums=donate)
 
     def _build_update(self):
         scale = (1.0 / self.k_steps) if self.gm_avg else 1.0
@@ -575,6 +619,9 @@ class SpmdTrainer:
                     res = self._compiled[key](
                         self.params, self.opt_state, self.buffers, lr,
                         step_no, *batch)
+            res = list(res)
+            guard = res.pop() if (self._check_nan_inf and
+                                  not self.fp16_scaling) else None
             if self.fp16_scaling and return_outputs:
                 (self.params, self.opt_state, self.buffers, loss,
                  self._scaler_state, outs) = res
@@ -588,6 +635,8 @@ class SpmdTrainer:
                 self.params, self.opt_state, self.buffers, loss = res
             self._step_count += 1
             self.optimizer._step_count = self._step_count
+            if guard is not None:
+                self._raise_nonfinite(guard)
             return (loss, outs) if return_outputs else loss
         if return_outputs:
             raise NotImplementedError(
@@ -601,9 +650,16 @@ class SpmdTrainer:
         if "update" not in self._compiled:
             self._compiled["update"] = self._build_update()
         with compile_mesh_guard(self.mesh):
-            self._grad_buf, self.buffers, loss = self._compiled[akey](
+            res = self._compiled[akey](
                 self.params, self._grad_buf, self.buffers, *batch)
+        if self._check_nan_inf:
+            self._grad_buf, self.buffers, loss, guard = res
+        else:
+            self._grad_buf, self.buffers, loss = res
+            guard = None
         self._step_count += 1
+        if guard is not None:
+            self._raise_nonfinite(guard)
         if self._step_count % self.k_steps == 0:
             step_no = jnp.asarray(
                 self._step_count // self.k_steps, jnp.int32)

@@ -1,0 +1,95 @@
+"""FLAGS_check_nan_inf coverage for the COMPILED train step.
+
+Reference: paddle/fluid/framework/details/nan_inf_utils_detail.cc:293
+(every kernel output is scanned when the flag is on and training aborts
+naming the bad tensor). Here the jitted step returns a per-tensor bool
+vector and the host raises PreconditionNotMetError with the names.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.errors import PreconditionNotMetError
+from paddle_tpu.distributed import SpmdTrainer, create_mesh
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+
+class NanAt(nn.Layer):
+    """Emits NaN when an input row carries the sentinel value."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 2)
+
+    def forward(self, x):
+        out = self.fc(x)
+        mask = (x > 900.0).astype("float32").max()
+        # log(1-mask): 0 on clean batches, -inf when the sentinel is
+        # present — poisons loss and grads only on demand
+        return out + paddle.log(1.0 - mask)
+
+
+def mse(out, y):
+    return F.mse_loss(out, y)
+
+
+def batch(sentinel=False):
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    if sentinel:
+        x[0, 0] = 1000.0
+    return x, rng.randn(4, 2).astype(np.float32)
+
+
+def make_trainer(**kw):
+    paddle.seed(0)
+    model = NanAt()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    return SpmdTrainer(model, opt, mse, mesh=create_mesh({"dp": 1}), **kw)
+
+
+def test_guard_off_by_default_trains_through_nan():
+    tr = make_trainer()
+    assert not tr._check_nan_inf
+    x, y = batch(sentinel=True)
+    loss = float(tr.train_step(x, y))  # silently inf, like any compiled fn
+    assert not np.isfinite(loss)
+
+
+def test_guard_catches_injected_nan_with_names():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        tr = make_trainer()
+        assert tr._check_nan_inf
+        x, y = batch()
+        assert np.isfinite(float(tr.train_step(x, y)))  # clean step ok
+        xb, yb = batch(sentinel=True)
+        with pytest.raises(PreconditionNotMetError) as ei:
+            tr.train_step(xb, yb)
+        assert "loss" in str(ei.value)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_guard_covers_gradient_merge_accum_path():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = NanAt()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        st = DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2}
+        tr = SpmdTrainer(model, opt, mse, mesh=create_mesh({"dp": 1}),
+                         strategy=st)
+        x, y = batch()
+        tr.train_step(x, y)  # clean accum
+        xb, yb = batch(sentinel=True)
+        with pytest.raises(PreconditionNotMetError):
+            tr.train_step(xb, yb)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
