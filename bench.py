@@ -65,7 +65,10 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True):
     crit = GPTPretrainingCriterion()
     st = DistributedStrategy()
     st.amp = True                      # bf16 params + activations
-    st.recompute = True                # remat blocks, selective policy:
+    # remat costs extra FLOPs; models that fit in HBM without it run
+    # faster with it off (BENCH_RECOMPUTE=0)
+    remat = os.environ.get("BENCH_RECOMPUTE", "1") != "0"
+    st.recompute = remat               # remat blocks, selective policy:
     # save matmul outputs ('dots'), recompute only cheap elementwise ops —
     # full remat pays the whole forward twice and caps MFU ~2/3
     st.recompute_configs = {"policy": "dots_no_batch"}
@@ -123,7 +126,7 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True):
         "loss": float(loss),
         "use_flash": use_flash,
         "flash_kernel_in_step": flash_in_step,
-        "remat_policy": "dots_no_batch",
+        "remat_policy": "dots_no_batch" if remat else "off",
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }

@@ -78,19 +78,36 @@ def barrier_worker():
     _rm()._barrier()
 
 
+_ps_server = None
+
+
 def init_worker():
     pass
 
 
 def init_server(*args, **kwargs):
-    pass
+    """Build this role's PS shard (reference fleet_base.py init_server):
+    binds the server endpoint from the role maker; tables are created
+    lazily by client ensure_table calls."""
+    global _ps_server
+    from ..ps import PSServer
+    rm = _rm()
+    eps = rm._get_pserver_endpoints()
+    if not eps:
+        raise RuntimeError(
+            "init_server: no PADDLE_PSERVERS_IP_PORT_LIST endpoints in "
+            "the environment (set by the launcher in PS mode)")
+    idx = rm._server_index()
+    _ps_server = PSServer(eps[idx], n_workers=max(rm._worker_num(), 1))
+    return _ps_server
 
 
 def run_server():
-    raise NotImplementedError(
-        "parameter-server mode: TPU training is collective-only; "
-        "PS workloads map to sharded embedding + collective training "
-        "(see paddle_tpu.distributed.parallel_layers.VocabParallelEmbedding)")
+    """Blocking PS serve loop (reference fleet.run_server).  The shard
+    must have been built by init_server()."""
+    if _ps_server is None:
+        init_server()
+    _ps_server.run()
 
 
 def stop_worker():

@@ -1,0 +1,23 @@
+"""Minimal host-side parameter server.
+
+Reference: /root/reference/paddle/fluid/distributed/service/
+(brpc_ps_server.h PSServer, ps_client.h PSClient) +
+table/common_dense_table.h / common_sparse_table.cc (the dense and
+sparse tables with per-table optimizer rules).
+
+Scope and TPU-native rationale: collective SPMD training over a mesh is
+this framework's primary scaling path (the reference's PS mode predates
+its collective mode and serves sparse-CTR workloads). This PS covers
+that workload class host-side: dense + id-keyed sparse tables with
+per-table SGD/Adagrad/Adam rules, served over a length-prefixed TCP
+protocol; trainers push gradients and pull fresh parameters fully
+asynchronously (a_sync mode, reference AsyncCommunicator) — dense HBM
+math stays on the TPU, the big sparse tables stay in host DRAM where
+they belong.
+"""
+from .table import DenseTable, SparseTable, sgd_rule, adagrad_rule, adam_rule  # noqa: F401
+from .server import PSServer  # noqa: F401
+from .client import PSClient  # noqa: F401
+
+__all__ = ["DenseTable", "SparseTable", "PSServer", "PSClient",
+           "sgd_rule", "adagrad_rule", "adam_rule"]

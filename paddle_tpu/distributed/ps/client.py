@@ -1,0 +1,154 @@
+"""PS client: shards tables over servers, talks the pickle protocol.
+
+Reference: ps_client.h / brpc_ps_client.cc (PSClient: pull_dense /
+push_dense_param / pull_sparse / push_sparse against N server shards).
+Sharding follows the reference: dense tables live whole on
+hash(name) % n_servers; sparse rows scatter by id % n_servers.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import zlib
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .server import recv_msg, send_msg
+
+__all__ = ["PSClient"]
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=60)
+        self.lock = threading.Lock()
+
+    def call(self, msg):
+        with self.lock:
+            send_msg(self.sock, msg)
+            reply = recv_msg(self.sock)
+        if reply is None:
+            raise ConnectionError("PS server closed the connection")
+        status, payload = reply
+        if status != "ok":
+            raise RuntimeError(f"PS server error: {payload}")
+        return payload
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    def __init__(self, endpoints: Sequence[str]):
+        if not endpoints:
+            raise ValueError("PSClient needs at least one server endpoint")
+        self.endpoints = list(endpoints)
+        self._conns: List[_Conn] = [_Conn(e) for e in self.endpoints]
+        self._dense_home: Dict[str, int] = {}
+        self._sparse_dims: Dict[str, int] = {}
+        # shard fan-out pool: the reference PSClient issues the per-shard
+        # RPCs concurrently; a serial loop would pay n_servers RTTs per
+        # training step
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(len(self._conns), 1),
+            thread_name_prefix="ps-client")
+
+    # ---- placement ----------------------------------------------------
+    def _dense_conn(self, name: str) -> _Conn:
+        if name not in self._dense_home:
+            self._dense_home[name] = zlib.crc32(name.encode()) % \
+                len(self._conns)
+        return self._conns[self._dense_home[name]]
+
+    # ---- table management ---------------------------------------------
+    def ensure_dense_table(self, name: str, shape, rule="sgd", init=None,
+                           seed=0):
+        spec = {"shape": tuple(shape), "rule": rule, "seed": seed}
+        if init is not None:
+            spec["init"] = np.asarray(init, np.float32)
+        self._dense_conn(name).call(("ensure_table", name, "dense", spec))
+
+    def ensure_sparse_table(self, name: str, dim: int, rule="sgd",
+                            init_scale=0.01, seed=0):
+        spec = {"dim": int(dim), "rule": rule, "init_scale": init_scale,
+                "seed": seed}
+        msg = ("ensure_table", name, "sparse", spec)
+        # every shard holds part of the id space
+        list(self._pool.map(lambda c: c.call(msg), self._conns))
+        self._sparse_dims[name] = int(dim)
+
+    def _sparse_dim(self, name: str) -> int:
+        if name not in self._sparse_dims:
+            self._sparse_dims[name] = int(
+                self._conns[0].call(("table_dim", name)))
+        return self._sparse_dims[name]
+
+    # ---- dense --------------------------------------------------------
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._dense_conn(name).call(("pull_dense", name))
+
+    def push_dense(self, name: str, grad, lr: float):
+        self._dense_conn(name).call(
+            ("push_dense", name, np.asarray(grad, np.float32), float(lr)))
+
+    # ---- sparse -------------------------------------------------------
+    def pull_sparse(self, name: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if len(ids) == 0:
+            return np.empty((0, self._sparse_dim(name)), np.float32)
+        n = len(self._conns)
+        shard_of = ids % n
+        jobs = []
+        for s in range(n):
+            mask = shard_of == s
+            if mask.any():
+                pos = np.nonzero(mask)[0]
+                jobs.append((pos, self._pool.submit(
+                    self._conns[s].call, ("pull_sparse", name, ids[mask]))))
+        first = jobs[0][1].result()
+        out = np.empty((len(ids), first.shape[1]), np.float32)
+        for pos, fut in jobs:
+            out[pos] = fut.result()
+        return out
+
+    def push_sparse(self, name: str, ids, grads, lr: float):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        n = len(self._conns)
+        shard_of = ids % n
+        futs = []
+        for s in range(n):
+            mask = shard_of == s
+            if mask.any():
+                futs.append(self._pool.submit(
+                    self._conns[s].call,
+                    ("push_sparse", name, ids[mask], grads[mask],
+                     float(lr))))
+        for f in futs:
+            f.result()
+
+    # ---- control ------------------------------------------------------
+    def barrier(self):
+        # barrier against shard 0 (all workers rendezvous in one place)
+        self._conns[0].call(("barrier",))
+
+    def sparse_table_size(self, name: str) -> int:
+        return sum(c.call(("table_size", name)) for c in self._conns)
+
+    def stop_all_servers(self):
+        for c in self._conns:
+            try:
+                c.call(("stop",))
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for c in self._conns:
+            c.close()

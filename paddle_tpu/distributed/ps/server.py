@@ -1,0 +1,214 @@
+"""PS server: threaded TCP service over a length-prefixed pickle protocol.
+
+Reference: brpc_ps_server.h (BrpcPsServer: an RPC service dispatching
+pull_dense / push_dense_param / pull_sparse / push_sparse to tables) —
+rebuilt on the standard-library socketserver instead of brpc; the
+protocol is 8-byte big-endian length + HMAC-SHA256 tag + pickled
+(cmd, *args) tuples, matching the launcher's plain-TCP transport.
+
+SECURITY: pickle over a socket is code execution for anyone who can
+write to it.  Every frame therefore carries an HMAC over the payload
+keyed by the PADDLE_PS_SECRET env var (the launcher distributes it to
+the pod like the reference's trainer env contract); frames with a bad
+tag are dropped before unpickling.  Binding a non-loopback address
+without a secret is refused outright.  Frame size is capped to stop a
+forged length prefix from OOMing the server.
+
+Async semantics (a_sync mode / AsyncCommunicator): every trainer's push
+applies immediately under the table lock — no cross-trainer barrier on
+the hot path. barrier() is available for epoch boundaries (reference
+_barrier worker semantics).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from .table import DenseTable, SparseTable
+
+__all__ = ["PSServer", "send_msg", "recv_msg"]
+
+_LEN = struct.Struct(">Q")
+_TAG_BYTES = 32
+MAX_FRAME = 1 << 31  # 2 GiB: far above any sane pull/push
+
+
+def _secret() -> bytes:
+    return os.environ.get("PADDLE_PS_SECRET", "").encode()
+
+
+def send_msg(sock: socket.socket, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    tag = hmac.new(_secret(), payload, hashlib.sha256).digest()
+    sock.sendall(_LEN.pack(len(payload)) + tag + payload)
+
+
+def recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"PS frame length {n} exceeds MAX_FRAME")
+    tag = _recv_exact(sock, _TAG_BYTES)
+    if tag is None:
+        return None
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    want = hmac.new(_secret(), body, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        raise ConnectionError(
+            "PS frame failed HMAC authentication (PADDLE_PS_SECRET "
+            "mismatch or untrusted sender)")
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "PSServer" = self.server.ps  # type: ignore[attr-defined]
+        while True:
+            msg = recv_msg(self.request)
+            if msg is None:
+                return
+            try:
+                reply = srv.dispatch(msg)
+            except Exception as e:  # surface server errors to the client
+                reply = ("err", f"{type(e).__name__}: {e}")
+            send_msg(self.request, reply)
+            if msg[0] == "stop":
+                return
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """One PS shard: tables + the request dispatcher."""
+
+    def __init__(self, endpoint: str, n_workers: int = 1):
+        host, port = endpoint.rsplit(":", 1)
+        if host not in ("127.0.0.1", "localhost", "::1") and not _secret():
+            raise RuntimeError(
+                "refusing to serve pickled frames on a non-loopback "
+                f"address ({host}) without PADDLE_PS_SECRET set — the "
+                "HMAC is the only thing keeping arbitrary hosts from "
+                "executing code via pickle")
+        self.endpoint = endpoint
+        self.n_workers = int(n_workers)
+        self.tables: Dict[str, object] = {}
+        self._tables_lock = threading.Lock()
+        self._tcp = _TCP((host, int(port)), _Handler)
+        self._tcp.ps = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._barrier_lock = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking serve (fleet.run_server role)."""
+        self.start()
+        self._stop_evt.wait()
+        self._tcp.shutdown()
+
+    def stop(self):
+        self._stop_evt.set()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # ---- dispatch -----------------------------------------------------
+    def dispatch(self, msg: Tuple):
+        cmd, *args = msg
+        if cmd == "ensure_table":
+            name, kind, spec = args
+            with self._tables_lock:  # concurrent workers both ensure
+                if name not in self.tables:
+                    if kind == "dense":
+                        self.tables[name] = DenseTable(**spec)
+                    elif kind == "sparse":
+                        self.tables[name] = SparseTable(**spec)
+                    else:
+                        raise ValueError(f"unknown table kind {kind}")
+            return ("ok", None)
+        if cmd == "pull_dense":
+            (name,) = args
+            return ("ok", self.tables[name].pull())
+        if cmd == "push_dense":
+            name, grad, lr = args
+            self.tables[name].push(grad, lr)
+            return ("ok", None)
+        if cmd == "pull_sparse":
+            name, ids = args
+            return ("ok", self.tables[name].pull(ids))
+        if cmd == "push_sparse":
+            name, ids, grads, lr = args
+            self.tables[name].push(ids, grads, lr)
+            return ("ok", None)
+        if cmd == "barrier":
+            return self._barrier()
+        if cmd == "table_size":
+            (name,) = args
+            t = self.tables[name]
+            return ("ok", t.size() if isinstance(t, SparseTable)
+                    else t.shape)
+        if cmd == "table_dim":
+            (name,) = args
+            t = self.tables[name]
+            return ("ok", t.dim if isinstance(t, SparseTable)
+                    else t.shape)
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok", None)
+        raise ValueError(f"unknown PS command {cmd!r}")
+
+    def _barrier(self):
+        """Block until n_workers calls arrive (reference barrier_worker).
+        A timeout (a peer died) un-registers this waiter and returns an
+        error so the caller cannot proceed unsynchronized — and the
+        count stays consistent for the next round."""
+        with self._barrier_lock:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self.n_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_lock.notify_all()
+                return ("ok", None)
+            released = self._barrier_lock.wait_for(
+                lambda: self._barrier_gen != gen, timeout=120)
+            if not released:
+                self._barrier_count -= 1
+                return ("err", "barrier timed out after 120s "
+                               "(a worker likely died)")
+        return ("ok", None)

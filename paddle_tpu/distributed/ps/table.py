@@ -1,0 +1,151 @@
+"""PS tables: dense and id-keyed sparse with pluggable optimizer rules.
+
+Reference: table/common_dense_table.h (dense values + sgd rule),
+common_sparse_table.cc (shard of id -> [value | optimizer-state] rows,
+rows materialize on first access with a configured initializer).
+
+Host-side numpy on purpose: these tables live in server DRAM and are
+touched a few rows at a time — the TPU never sees them whole.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["DenseTable", "SparseTable", "sgd_rule", "adagrad_rule",
+           "adam_rule"]
+
+
+# ---- optimizer rules ------------------------------------------------------
+# A rule is (state_factory, apply): state_factory(shape) -> dict of
+# state arrays; apply(value, grad, state, lr) mutates value/state inplace.
+
+def _sgd_apply(v, g, s, lr):
+    v -= lr * g
+
+
+def sgd_rule():
+    return (lambda shape: {}, _sgd_apply)
+
+
+def _adagrad_state(shape):
+    return {"g2": np.zeros(shape, np.float32)}
+
+
+def _adagrad_apply(v, g, s, lr, eps=1e-6):
+    s["g2"] += g * g
+    v -= lr * g / (np.sqrt(s["g2"]) + eps)
+
+
+def _adam_state(shape):
+    return {"m": np.zeros(shape, np.float32),
+            "v2": np.zeros(shape, np.float32),
+            "t": np.zeros((), np.int64)}
+
+
+def _adam_apply(v, g, s, lr, b1=0.9, b2=0.999, eps=1e-8):
+    s["t"] += 1
+    t = int(s["t"])
+    s["m"] = b1 * s["m"] + (1 - b1) * g
+    s["v2"] = b2 * s["v2"] + (1 - b2) * g * g
+    mhat = s["m"] / (1 - b1 ** t)
+    vhat = s["v2"] / (1 - b2 ** t)
+    v -= lr * mhat / (np.sqrt(vhat) + eps)
+
+
+def adagrad_rule():
+    return (_adagrad_state, _adagrad_apply)
+
+
+def adam_rule():
+    return (_adam_state, _adam_apply)
+
+
+_RULES = {"sgd": sgd_rule, "adagrad": adagrad_rule, "adam": adam_rule}
+
+
+def get_rule(name: str):
+    if name not in _RULES:
+        raise ValueError(f"unknown PS optimizer rule {name!r}; "
+                         f"have {sorted(_RULES)}")
+    return _RULES[name]()
+
+
+# ---- tables ---------------------------------------------------------------
+class DenseTable:
+    """Flat dense parameter block (common_dense_table.h role)."""
+
+    kind = "dense"
+
+    def __init__(self, shape, rule: str = "sgd",
+                 init: Optional[np.ndarray] = None, seed: int = 0):
+        self.shape = tuple(shape)
+        if init is not None:
+            self.value = np.array(init, np.float32).reshape(self.shape)
+        else:
+            rng = np.random.RandomState(seed)
+            self.value = (rng.randn(*self.shape) * 0.01).astype(np.float32)
+        self._state_factory, self._apply = get_rule(rule)
+        self._state = self._state_factory(self.shape)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray, lr: float):
+        g = np.asarray(grad, np.float32).reshape(self.shape)
+        with self._lock:
+            self._apply(self.value, g, self._state, lr)
+
+
+class SparseTable:
+    """id -> row table; rows materialize on first touch
+    (common_sparse_table.cc shard semantics)."""
+
+    kind = "sparse"
+
+    def __init__(self, dim: int, rule: str = "sgd", init_scale: float = 0.01,
+                 seed: int = 0):
+        self.dim = int(dim)
+        self.init_scale = float(init_scale)
+        self._seed = int(seed)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._states: Dict[int, dict] = {}
+        self._state_factory, self._apply = get_rule(rule)
+        self._lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self._rows.get(i)
+        if r is None:
+            # deterministic per-id init so every server shard agrees
+            rng = np.random.RandomState((self._seed * 1000003 + i)
+                                        & 0x7FFFFFFF)
+            r = (rng.randn(self.dim) * self.init_scale).astype(np.float32)
+            self._rows[i] = r
+            self._states[i] = self._state_factory((self.dim,))
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads, lr: float):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        # aggregate duplicate ids first (MergeAdd) so the rule sees one
+        # gradient per row, like the reference's merged push
+        order: Dict[int, np.ndarray] = {}
+        for i, gi in zip(ids, g):
+            i = int(i)
+            order[i] = order[i] + gi if i in order else gi.copy()
+        with self._lock:
+            for i, gi in order.items():
+                self._apply(self._row(i), gi, self._states[i], lr)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)

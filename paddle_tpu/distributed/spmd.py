@@ -412,11 +412,12 @@ class SpmdTrainer:
                 flags.append(jnp.asarray(False))
         return jnp.stack(flags)
 
-    def _raise_nonfinite(self, vec):
+    def _raise_nonfinite(self, vec, names=None):
         import numpy as _np
         bad = _np.asarray(vec)
         if bad.any():
-            names = [n for n, b in zip(self._nanguard_names(), bad) if b]
+            names = names or self._nanguard_names()
+            names = [n for n, b in zip(names, bad) if b]
             from ..core.errors import PreconditionNotMetError
             raise PreconditionNotMetError(
                 f"FLAGS_check_nan_inf: nan/inf detected in compiled "
@@ -521,9 +522,17 @@ class SpmdTrainer:
                           "found_inf": found_inf}
             merged = dict(buffers)
             merged.update(new_buffers)
+            # FLAGS_check_nan_inf under fp16: grad infs are the scaler's
+            # legitimate skip signal, but a non-finite UNSCALED loss is a
+            # real divergence (log of a negative, etc.) the flag must
+            # catch — the scaler would otherwise shrink the scale forever
+            extra = ((~jnp.isfinite(loss))[None],) \
+                if self._check_nan_inf else ()
             if with_outputs:
-                return new_params, new_opt, merged, loss, new_scaler, outs
-            return new_params, new_opt, merged, loss, new_scaler
+                return (new_params, new_opt, merged, loss, new_scaler,
+                        outs) + extra
+            return (new_params, new_opt, merged, loss,
+                    new_scaler) + extra
 
         donate = (0, 1, 2, 3) if self._donate else ()
         scaler_sh = dict(self._scaler_shardings)
@@ -531,6 +540,8 @@ class SpmdTrainer:
                      self._buffer_shardings, self._repl, scaler_sh)
         if with_outputs:
             shardings = shardings + (None,)
+        if self._check_nan_inf:
+            shardings = shardings + (self._repl,)
         return jax.jit(step, out_shardings=shardings,
                        donate_argnums=donate)
 
@@ -620,8 +631,7 @@ class SpmdTrainer:
                         self.params, self.opt_state, self.buffers, lr,
                         step_no, *batch)
             res = list(res)
-            guard = res.pop() if (self._check_nan_inf and
-                                  not self.fp16_scaling) else None
+            guard = res.pop() if self._check_nan_inf else None
             if self.fp16_scaling and return_outputs:
                 (self.params, self.opt_state, self.buffers, loss,
                  self._scaler_state, outs) = res
@@ -636,7 +646,8 @@ class SpmdTrainer:
             self._step_count += 1
             self.optimizer._step_count = self._step_count
             if guard is not None:
-                self._raise_nonfinite(guard)
+                self._raise_nonfinite(
+                    guard, names=["loss"] if self.fp16_scaling else None)
             return (loss, outs) if return_outputs else loss
         if return_outputs:
             raise NotImplementedError(

@@ -74,6 +74,31 @@ def test_guard_catches_injected_nan_with_names():
         paddle.set_flags({"FLAGS_check_nan_inf": False})
 
 
+def test_guard_fp16_catches_nan_loss_but_not_grad_overflow():
+    """Under fp16 scaling, grad infs are the scaler's skip signal (no
+    abort), but a non-finite UNSCALED loss must still raise — otherwise
+    the scaler shrinks forever on a genuinely divergent model."""
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        paddle.seed(0)
+        model = NanAt()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        st = DistributedStrategy()
+        st.amp = True
+        st.amp_configs = {"use_bf16": False,
+                          "init_loss_scaling": 2.0 ** 14}
+        tr = SpmdTrainer(model, opt, mse, mesh=create_mesh({"dp": 1}),
+                         strategy=st)
+        x, y = batch()
+        assert np.isfinite(float(tr.train_step(x, y)))
+        xb, yb = batch(sentinel=True)
+        with pytest.raises(PreconditionNotMetError, match="loss"):
+            tr.train_step(xb, yb)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
 def test_guard_covers_gradient_merge_accum_path():
     paddle.set_flags({"FLAGS_check_nan_inf": True})
     try:

@@ -1,0 +1,162 @@
+"""Minimal host-side parameter server.
+
+Reference: paddle/fluid/distributed/service/brpc_ps_server.h (server),
+ps_client.h (client), table/common_dense_table.h + common_sparse_table.cc
+(tables + per-table optimizer rules), and the a_sync training mode
+(AsyncCommunicator): trainers push grads / pull params with no
+cross-trainer synchronization on the hot path.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (DenseTable, PSClient, PSServer,
+                                       SparseTable)
+
+
+def start_servers(n=2, n_workers=1):
+    servers = [PSServer("127.0.0.1:0", n_workers=n_workers) for _ in
+               range(n)]
+    eps = []
+    for s in servers:
+        s.start()
+        eps.append(f"127.0.0.1:{s.port}")
+    return servers, eps
+
+
+def test_dense_table_rules():
+    t = DenseTable((2, 3), rule="sgd", init=np.ones((2, 3)))
+    t.push(np.full((2, 3), 0.5), lr=0.1)
+    np.testing.assert_allclose(t.pull(), 0.95)
+    a = DenseTable((4,), rule="adagrad", init=np.zeros(4))
+    a.push(np.ones(4), lr=1.0)
+    # adagrad first step: -lr * g / (sqrt(g^2) + eps) ~ -1
+    np.testing.assert_allclose(a.pull(), -1.0, atol=1e-4)
+
+
+def test_sparse_table_lazy_rows_and_merge():
+    t = SparseTable(dim=4, rule="sgd", init_scale=0.0)
+    rows = t.pull([5, 9])
+    np.testing.assert_array_equal(rows, np.zeros((2, 4)))
+    # duplicate ids in one push aggregate before the rule applies
+    t.push([5, 5], np.ones((2, 4)), lr=0.1)
+    np.testing.assert_allclose(t.pull([5])[0], -0.2, atol=1e-6)
+    assert t.size() == 2
+
+
+def test_client_server_dense_and_sparse_roundtrip():
+    servers, eps = start_servers(2)
+    try:
+        cli = PSClient(eps)
+        cli.ensure_dense_table("w", (3, 2), rule="sgd",
+                               init=np.zeros((3, 2)))
+        cli.push_dense("w", np.ones((3, 2)), lr=0.5)
+        np.testing.assert_allclose(cli.pull_dense("w"), -0.5)
+
+        cli.ensure_sparse_table("emb", dim=3, rule="sgd", init_scale=0.0)
+        ids = np.array([0, 1, 2, 3, 7, 8], np.int64)  # spans both shards
+        np.testing.assert_array_equal(cli.pull_sparse("emb", ids),
+                                      np.zeros((6, 3)))
+        g = np.arange(18, dtype=np.float32).reshape(6, 3)
+        cli.push_sparse("emb", ids, g, lr=1.0)
+        np.testing.assert_allclose(cli.pull_sparse("emb", ids), -g)
+        # rows landed on the right shards: total row count adds up
+        assert cli.sparse_table_size("emb") == 6
+        # empty pull keeps the row width (0, dim), not (0, 0)
+        assert cli.pull_sparse("emb", np.empty(0, np.int64)).shape == (0, 3)
+        cli.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_server_error_propagates_to_client():
+    servers, eps = start_servers(1)
+    try:
+        cli = PSClient(eps)
+        with pytest.raises(RuntimeError, match="KeyError"):
+            cli.pull_dense("never_created")
+        cli.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_two_async_trainers_converge():
+    """The a_sync workload: two trainer threads fit a shared linear
+    model (dense weights + sparse embedding) against their own data
+    with NO synchronization between them — the PS serializes updates
+    per table and the average loss must fall."""
+    servers, eps = start_servers(2, n_workers=2)
+    losses = {0: [], 1: []}
+    try:
+        boot = PSClient(eps)
+        rng0 = np.random.RandomState(42)
+        w_true = rng0.randn(4, 1).astype(np.float32)
+        emb_true = rng0.randn(10, 4).astype(np.float32)
+        # nonzero init: an all-zero bilinear model sits on a saddle
+        # where both gradients vanish
+        boot.ensure_dense_table("w", (4, 1), rule="sgd",
+                                init=rng0.randn(4, 1) * 0.5)
+        boot.ensure_sparse_table("emb", dim=4, rule="adagrad",
+                                 init_scale=0.1)
+        boot.close()
+
+        def trainer(rank):
+            cli = PSClient(eps)
+            rng = np.random.RandomState(rank)
+            for step in range(150):
+                ids = rng.randint(0, 10, (16,)).astype(np.int64)
+                x = emb_true[ids]                 # features via lookup
+                y = x @ w_true
+                # forward with the CURRENT server params
+                w = cli.pull_dense("w")
+                e = cli.pull_sparse("emb", ids)
+                pred = e @ w
+                err = pred - y                    # [16, 1]
+                losses[rank].append(float((err ** 2).mean()))
+                # backward: dL/dw = e^T err / n; dL/de = err w^T / n
+                n = len(ids)
+                cli.push_dense("w", e.T @ err / n, lr=0.05)
+                cli.push_sparse("emb", ids, err @ w.T / n, lr=0.3)
+            cli.barrier()
+            cli.close()
+
+        ts = [threading.Thread(target=trainer, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+            assert not t.is_alive(), "trainer hung"
+        for rank in (0, 1):
+            first = np.mean(losses[rank][:10])
+            last = np.mean(losses[rank][-10:])
+            assert last < first * 0.5, \
+                f"rank {rank}: {first:.4f} -> {last:.4f}"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_fleet_init_server_from_env(monkeypatch):
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet import role_maker as rm_mod
+
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:0")
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PORT", "0")
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    # fresh role maker picking up the env
+    fleet.base._role_maker = rm_mod.PaddleCloudRoleMaker()
+    srv = fleet.init_server()
+    try:
+        srv.start()
+        cli = PSClient([f"127.0.0.1:{srv.port}"])
+        cli.ensure_dense_table("t", (2,), init=np.zeros(2))
+        np.testing.assert_array_equal(cli.pull_dense("t"), np.zeros(2))
+        cli.close()
+    finally:
+        srv.stop()
+        fleet.base._role_maker = None
+        fleet.base._ps_server = None
