@@ -1,0 +1,114 @@
+"""Custom-op extension API — register your own kernels as framework ops.
+
+Reference: /root/reference/paddle/fluid/extension/ (ext_op_meta_info.h
+PD_BUILD_OP / PD_BUILD_GRAD_OP macros, framework/custom_operator.cc
+registration) + python/paddle/utils/cpp_extension.  There a user writes
+a C++ kernel, compiles it, and the loader registers forward/backward ops.
+
+TPU-native shape: a "kernel" is any jax-traceable function — jnp code or
+a Pallas TPU kernel — so registration needs no compiler toolchain.
+`register_op(name, forward, backward=...)` produces an op that:
+- participates in the eager autograd tape (custom backward honored),
+- traces into jit/to_static/SpmdTrainer steps like any built-in op,
+- is discoverable via get_op(name) / list_ops().
+
+The backward contract mirrors PD_BUILD_GRAD_OP: it receives the saved
+forward inputs, the forward outputs, and the output cotangents, and
+returns one gradient per forward input (None for non-differentiable
+inputs).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+from .core.autograd import apply
+
+__all__ = ["register_op", "get_op", "list_ops", "CustomOp"]
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom operator (callable)."""
+
+    def __init__(self, name: str, forward: Callable,
+                 backward: Optional[Callable] = None):
+        self.name = name
+        self._forward = forward
+        self._backward = backward
+        if backward is not None:
+            fwd = jax.custom_vjp(forward)
+
+            def _fwd(*args):
+                out = forward(*args)
+                return out, (args, out)
+
+            def _bwd(res, cots):
+                args, out = res
+                grads = backward(args, out, cots)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                if len(grads) != len(args):
+                    raise ValueError(
+                        f"custom op {name!r}: backward returned "
+                        f"{len(grads)} grads for {len(args)} inputs")
+                # None -> zero cotangent (non-differentiable input)
+                import jax.numpy as jnp
+                return tuple(
+                    jnp.zeros_like(a) if g is None else g
+                    for a, g in zip(args, grads))
+
+            fwd.defvjp(_fwd, _bwd)
+            self._traced = fwd
+        else:
+            self._traced = forward
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            # static config args bind by closure, like attrs in the
+            # reference's op attrs
+            import functools
+            fn = functools.partial(self._traced, **kwargs)
+        else:
+            fn = self._traced
+        return apply(fn, *args, name=self.name)
+
+    @property
+    def raw(self) -> Callable:
+        """The jax-level function (for use inside other jax code)."""
+        return self._traced
+
+
+def register_op(name: str, forward: Optional[Callable] = None,
+                backward: Optional[Callable] = None):
+    """Register a custom op. Usable directly or as a decorator:
+
+        @register_op("fused_swiglu")
+        def fused_swiglu(x, w): ...
+
+        def gelu_grad(inputs, outputs, cotangents): ...
+        op = register_op("my_gelu", my_gelu, backward=gelu_grad)
+    """
+    def _register(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"custom op {name!r} already registered")
+        op = CustomOp(name, fn, backward)
+        _REGISTRY[name] = op
+        return op
+
+    if forward is None:
+        return _register
+    return _register(forward)
+
+
+def get_op(name: str) -> CustomOp:
+    if name not in _REGISTRY:
+        raise KeyError(f"no custom op named {name!r}; registered: "
+                       f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_ops():
+    return sorted(_REGISTRY)

@@ -1,0 +1,277 @@
+"""Detection op family — boxes, IoU, NMS, anchors, box coding.
+
+Reference: /root/reference/paddle/fluid/operators/detection/
+(bbox_util.h box math, iou_similarity_op.h, box_coder_op.h encode/
+decode, nms in multiclass_nms_op.cc, prior_box_op.h anchors) and
+python/paddle/fluid/layers/detection.py.
+
+TPU-native shape: every op is fixed-shape, mask-based jnp code — NMS is
+the classic O(n²) IoU matrix + sequential suppression via lax.scan over
+score rank (no dynamic shapes: outputs are index/keep vectors padded to
+the input size), so the whole family jits and differentiates where it
+makes sense.  Boxes are [N, 4] (x1, y1, x2, y2) unless noted.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+
+__all__ = ["box_area", "box_iou", "iou_similarity", "box_clip",
+           "box_coder", "nms", "multiclass_nms", "prior_box",
+           "generate_anchors", "detection_map"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    def fn(b):
+        return jnp.clip(b[..., 2] - b[..., 0], 0) * \
+            jnp.clip(b[..., 3] - b[..., 1], 0)
+    return apply(fn, boxes, name="box_area")
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N, M] (bbox_util.h JaccardOverlap)."""
+    def fn(a, b):
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * \
+            jnp.clip(a[:, 3] - a[:, 1], 0)
+        area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * \
+            jnp.clip(b[:, 3] - b[:, 1], 0)
+        union = area_a[:, None] + area_b[None, :] - inter
+        return inter / jnp.maximum(union, 1e-10)
+    return apply(fn, boxes1, boxes2, name="box_iou")
+
+
+iou_similarity = box_iou  # reference iou_similarity_op name
+
+
+def box_clip(boxes, im_shape):
+    """Clip boxes into the image (box_clip_op.h). im_shape: (h, w)."""
+    h, w = (float(im_shape[0]), float(im_shape[1]))
+
+    def fn(b):
+        return jnp.stack([
+            jnp.clip(b[..., 0], 0, w), jnp.clip(b[..., 1], 0, h),
+            jnp.clip(b[..., 2], 0, w), jnp.clip(b[..., 3], 0, h),
+        ], axis=-1)
+    return apply(fn, boxes, name="box_clip")
+
+
+def box_coder(prior_boxes, target, code_type="encode_center_size",
+              variance: Optional[Sequence[float]] = None):
+    """Encode gt boxes against anchors / decode deltas back to boxes
+    (box_coder_op.h EncodeCenterSize / DecodeCenterSize)."""
+    var = jnp.asarray(variance if variance is not None
+                      else (1.0, 1.0, 1.0, 1.0), jnp.float32)
+
+    def enc(p, t):
+        pw = p[..., 2] - p[..., 0]
+        ph = p[..., 3] - p[..., 1]
+        pcx = p[..., 0] + 0.5 * pw
+        pcy = p[..., 1] + 0.5 * ph
+        tw = t[..., 2] - t[..., 0]
+        th = t[..., 3] - t[..., 1]
+        tcx = t[..., 0] + 0.5 * tw
+        tcy = t[..., 1] + 0.5 * th
+        return jnp.stack([
+            (tcx - pcx) / pw / var[0], (tcy - pcy) / ph / var[1],
+            jnp.log(jnp.maximum(tw / pw, 1e-10)) / var[2],
+            jnp.log(jnp.maximum(th / ph, 1e-10)) / var[3],
+        ], axis=-1)
+
+    def dec(p, d):
+        pw = p[..., 2] - p[..., 0]
+        ph = p[..., 3] - p[..., 1]
+        pcx = p[..., 0] + 0.5 * pw
+        pcy = p[..., 1] + 0.5 * ph
+        cx = d[..., 0] * var[0] * pw + pcx
+        cy = d[..., 1] * var[1] * ph + pcy
+        w = jnp.exp(d[..., 2] * var[2]) * pw
+        h = jnp.exp(d[..., 3] * var[3]) * ph
+        return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                          cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+
+    fn = enc if code_type.startswith("encode") else dec
+    return apply(fn, prior_boxes, target, name="box_coder")
+
+
+def nms(boxes, scores, iou_threshold=0.5, score_threshold=None,
+        top_k: Optional[int] = None):
+    """Greedy NMS (multiclass_nms_op.cc NMSFast). Returns kept indices
+    by descending score — a Tensor of int32 (eager: trimmed to the kept
+    count; the jit-safe core keeps a fixed-size keep mask)."""
+    b = _arr(boxes).astype(jnp.float32)
+    s = _arr(scores).astype(jnp.float32)
+    keep = _nms_mask(b, s, float(iou_threshold),
+                     -jnp.inf if score_threshold is None
+                     else float(score_threshold))
+    order = jnp.argsort(-s)
+    kept = order[keep[order]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(kept.astype(jnp.int32))
+
+
+def _nms_mask(b, s, iou_thr, score_thr):
+    """Fixed-shape NMS core: scan over score rank, suppressing against
+    the accumulated keep set (jit-friendly: no dynamic shapes)."""
+    n = b.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), bool)
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                              1e-10)
+    order = jnp.argsort(-s)
+
+    def body(keep, i):
+        idx = order[i]
+        ok = (s[idx] > score_thr) & \
+            ~jnp.any(keep & (iou[idx] > iou_thr))
+        return keep.at[idx].set(ok), None
+
+    keep0 = jnp.zeros((n,), bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    return keep
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).
+    bboxes: [N, 4]; scores: [C, N]. Returns [M, 6] rows of
+    (class, score, x1, y1, x2, y2), best first. Host-trimmed output."""
+    b = np.asarray(_arr(bboxes), np.float32)
+    sc = np.asarray(_arr(scores), np.float32)
+    rows = []
+    for c in range(sc.shape[0]):
+        s = sc[c]
+        cand = np.nonzero(s > score_threshold)[0]
+        if len(cand) == 0:
+            continue
+        cand = cand[np.argsort(-s[cand])][:nms_top_k]
+        kept = np.asarray(nms(b[cand], s[cand],
+                              iou_threshold=nms_threshold).data)
+        for i in kept:
+            gi = cand[int(i)]
+            rows.append((float(c), float(s[gi]), *b[gi].tolist()))
+    rows.sort(key=lambda r: -r[1])
+    rows = rows[:keep_top_k]
+    out = np.asarray(rows, np.float32).reshape(-1, 6)
+    return Tensor(jnp.asarray(out))
+
+
+def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
+              max_sizes=(), aspect_ratios=(1.0,), flip=False,
+              step=None, offset=0.5, clip=False):
+    """SSD prior boxes over a feature grid (prior_box_op.h). Returns
+    [H, W, A, 4] in normalized (x1, y1, x2, y2)."""
+    ars = list(aspect_ratios)
+    if flip:
+        ars = ars + [1.0 / a for a in aspect_ratios if a != 1.0]
+    step_x = step or image_w / feature_w
+    step_y = step or image_h / feature_h
+    whs = []
+    for ms in min_sizes:
+        for a in ars:
+            whs.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+        for Ms in max_sizes:
+            whs.append((np.sqrt(ms * Ms), np.sqrt(ms * Ms)))
+    whs = np.asarray(whs, np.float32)              # [A, 2]
+    cx = (np.arange(feature_w) + offset) * step_x  # [W]
+    cy = (np.arange(feature_h) + offset) * step_y  # [H]
+    cxg, cyg = np.meshgrid(cx, cy)                 # [H, W]
+    centers = np.stack([cxg, cyg], -1)[:, :, None, :]      # [H,W,1,2]
+    half = whs[None, None, :, :] / 2
+    out = np.concatenate([centers - half, centers + half], -1)
+    out = out / np.asarray([image_w, image_h, image_w, image_h],
+                           np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return Tensor(jnp.asarray(out.astype(np.float32)))
+
+
+def detection_map(detections, gt_boxes, gt_labels,
+                  overlap_threshold=0.5, ap_version="integral"):
+    """Mean average precision over a detection set
+    (reference detection_map_op.cc / fluid/metrics.py DetectionMAP).
+
+    detections: list per image of [M, 6] rows (class, score, x1..y2)
+    (multiclass_nms output); gt_boxes/gt_labels: lists per image of
+    [G, 4] and [G].  ap_version: 'integral' (VOC2010 AUC) or '11point'.
+    Host-side metric math, like the reference's CPU-only op.
+    """
+    per_class = {}
+    npos = {}
+    for img, (det, gtb, gtl) in enumerate(
+            zip(detections, gt_boxes, gt_labels)):
+        det = np.asarray(_arr(det), np.float32).reshape(-1, 6)
+        gtb = np.asarray(_arr(gtb), np.float32).reshape(-1, 4)
+        gtl = np.asarray(_arr(gtl)).reshape(-1).astype(np.int64)
+        for c in gtl:
+            npos[int(c)] = npos.get(int(c), 0) + 1
+        matched = np.zeros(len(gtb), bool)
+        for row in det[np.argsort(-det[:, 1])]:
+            c, score = int(row[0]), float(row[1])
+            cand = np.nonzero(gtl == c)[0]
+            best, best_iou = -1, overlap_threshold
+            if len(cand):
+                ious = np.asarray(box_iou(
+                    row[None, 2:6], gtb[cand]).data)[0]
+                j = int(np.argmax(ious))
+                if ious[j] >= best_iou and not matched[cand[j]]:
+                    best = cand[j]
+            tp = best >= 0
+            if tp:
+                matched[best] = True
+            per_class.setdefault(c, []).append((score, tp))
+    aps = []
+    for c, rows in per_class.items():
+        rows.sort(key=lambda r: -r[0])
+        tps = np.cumsum([r[1] for r in rows])
+        fps = np.cumsum([not r[1] for r in rows])
+        recall = tps / max(npos.get(c, 0), 1)
+        precision = tps / np.maximum(tps + fps, 1)
+        if ap_version == "11point":
+            ap = float(np.mean([
+                precision[recall >= t].max() if (recall >= t).any()
+                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:  # integral: area under interpolated PR curve
+            prec = np.maximum.accumulate(precision[::-1])[::-1]
+            rec = np.concatenate([[0.0], recall])
+            ap = float(np.sum((rec[1:] - rec[:-1]) * prec))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def generate_anchors(feature_h, feature_w, stride, sizes=(32, 64, 128),
+                     aspect_ratios=(0.5, 1.0, 2.0)):
+    """RPN-style anchors (anchor_generator_op.h): [H, W, A, 4] in image
+    coordinates."""
+    whs = []
+    for sz in sizes:
+        for a in aspect_ratios:
+            whs.append((sz * np.sqrt(a), sz / np.sqrt(a)))
+    whs = np.asarray(whs, np.float32)
+    cx = (np.arange(feature_w) + 0.5) * stride
+    cy = (np.arange(feature_h) + 0.5) * stride
+    cxg, cyg = np.meshgrid(cx, cy)
+    centers = np.stack([cxg, cyg], -1)[:, :, None, :]
+    half = whs[None, None, :, :] / 2
+    out = np.concatenate([centers - half, centers + half], -1)
+    return Tensor(jnp.asarray(out.astype(np.float32)))

@@ -1,0 +1,234 @@
+"""Custom-op extension API, sequence (LoD) op family, detection ops.
+
+References: fluid/extension (PD_BUILD_OP custom operators),
+operators/sequence_ops/ (masked-dense equivalents),
+operators/detection/ (iou/nms/box_coder/mAP).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.extension import register_op, get_op, list_ops
+from paddle_tpu.tensor.sequence import (
+    sequence_concat, sequence_enumerate, sequence_expand,
+    sequence_pad, sequence_pool, sequence_reverse, sequence_slice,
+    sequence_softmax, sequence_unpad)
+from paddle_tpu.vision import ops as V
+
+
+# ---- custom ops -----------------------------------------------------------
+def test_custom_op_forward_and_builtin_grad():
+    op = register_op("t_square", lambda x: x * x)
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [4.0, 6.0])
+    assert "t_square" in list_ops()
+    assert get_op("t_square") is op
+
+
+def test_custom_op_custom_backward():
+    calls = []
+
+    def fwd(x):
+        return jnp.exp(x)
+
+    def bwd(inputs, outputs, cots):
+        calls.append(1)
+        (x,) = inputs
+        return (cots * outputs * 2.0,)  # deliberately 2x the true grad
+
+    op = register_op("t_exp2grad", fwd, backward=bwd)
+    x = paddle.to_tensor(np.array([0.0, 1.0], np.float32),
+                         stop_gradient=False)
+    op(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data),
+                               2.0 * np.exp([0.0, 1.0]), rtol=1e-6)
+    assert calls  # the registered backward actually ran
+
+
+def test_custom_op_in_jit_and_layer():
+    op = register_op("t_gelu_ish", lambda x: x * jnp.tanh(x))
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            return op(self.fc(x))
+
+    net = Net()
+    sfn = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(sfn(x).data),
+                               np.asarray(net(x).data), rtol=1e-6)
+
+
+def test_custom_op_duplicate_name_raises():
+    register_op("t_dup", lambda x: x)
+    with pytest.raises(ValueError):
+        register_op("t_dup", lambda x: x)
+
+
+# ---- sequence ops ---------------------------------------------------------
+def _ragged():
+    x = np.zeros((2, 4, 3), np.float32)
+    x[0, :3] = np.arange(9).reshape(3, 3)
+    x[1, :2] = np.arange(6).reshape(2, 3) + 10
+    return paddle.to_tensor(x), paddle.to_tensor(
+        np.array([3, 2], np.int64))
+
+
+def test_sequence_pool_types():
+    x, ln = _ragged()
+    xa = np.asarray(x.data)
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(x, ln, "sum").data),
+        np.stack([xa[0, :3].sum(0), xa[1, :2].sum(0)]))
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(x, ln, "mean").data),
+        np.stack([xa[0, :3].mean(0), xa[1, :2].mean(0)]))
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(x, ln, "max").data),
+        np.stack([xa[0, :3].max(0), xa[1, :2].max(0)]))
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(x, ln, "last").data),
+        np.stack([xa[0, 2], xa[1, 1]]))
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(x, ln, "first").data),
+        np.stack([xa[0, 0], xa[1, 0]]))
+
+
+def test_sequence_softmax_masks_padding():
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    ln = paddle.to_tensor(np.array([2, 4], np.int64))
+    p = np.asarray(sequence_softmax(x, ln).data)
+    np.testing.assert_allclose(p[0], [0.5, 0.5, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(p[1], [0.25] * 4, atol=1e-6)
+
+
+def test_sequence_reverse_prefix_only():
+    x, ln = _ragged()
+    r = np.asarray(sequence_reverse(x, ln).data)
+    xa = np.asarray(x.data)
+    np.testing.assert_array_equal(r[0, :3], xa[0, :3][::-1])
+    np.testing.assert_array_equal(r[0, 3], xa[0, 3])  # padding unmoved
+    np.testing.assert_array_equal(r[1, :2], xa[1, :2][::-1])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    seqs = [np.arange(3, dtype=np.float32),
+            np.arange(5, dtype=np.float32) + 10]
+    padded, ln = sequence_pad(seqs, pad_value=-1.0)
+    assert padded.shape == [2, 5]
+    assert np.asarray(padded.data)[0, 3] == -1.0
+    back = sequence_unpad(padded, ln)
+    for a, b in zip(seqs, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sequence_expand_concat_enumerate_slice():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    ref = paddle.to_tensor(np.array([2, 3], np.int64))
+    ex = np.asarray(sequence_expand(x, ref).data)
+    np.testing.assert_array_equal(ex.reshape(-1), [1, 1, 2, 2, 2])
+
+    a = paddle.to_tensor(np.array([[1, 2, 0], [3, 0, 0]], np.float32))
+    la = paddle.to_tensor(np.array([2, 1], np.int64))
+    b = paddle.to_tensor(np.array([[7, 0], [8, 9]], np.float32))
+    lb = paddle.to_tensor(np.array([1, 2], np.int64))
+    cat, lc = sequence_concat([a, b], [la, lb])
+    np.testing.assert_array_equal(np.asarray(lc.data), [3, 3])
+    np.testing.assert_array_equal(np.asarray(cat.data),
+                                  [[1, 2, 7], [3, 8, 9]])
+
+    en = np.asarray(sequence_enumerate(
+        paddle.to_tensor(np.array([[1, 2, 3]], np.int64)), 2).data)
+    np.testing.assert_array_equal(en[0], [[1, 2], [2, 3], [3, 0]])
+
+    s, ls = sequence_slice(cat, lc,
+                           np.array([1, 0], np.int64),
+                           np.array([2, 1], np.int64))
+    np.testing.assert_array_equal(np.asarray(s.data), [[2, 7], [3, 0]])
+    np.testing.assert_array_equal(np.asarray(ls.data), [2, 1])
+
+
+def test_sequence_pool_differentiable():
+    x, ln = _ragged()
+    x.stop_gradient = False
+    sequence_pool(x, ln, "mean").sum().backward()
+    g = np.asarray(x.grad.data)
+    np.testing.assert_allclose(g[0, :3], 1 / 3, atol=1e-6)
+    np.testing.assert_allclose(g[0, 3], 0.0)  # padding gets no grad
+    np.testing.assert_allclose(g[1, :2], 1 / 2, atol=1e-6)
+
+
+# ---- detection ops --------------------------------------------------------
+def test_box_iou_and_area():
+    a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+    b = paddle.to_tensor(np.array([[1, 1, 3, 3], [4, 4, 5, 5]],
+                                  np.float32))
+    iou = np.asarray(V.box_iou(a, b).data)
+    np.testing.assert_allclose(iou, [[1 / 7, 0.0]], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(V.box_area(b).data), [4.0, 1.0])
+
+
+def test_box_coder_roundtrip():
+    priors = paddle.to_tensor(
+        np.array([[0, 0, 4, 4], [2, 2, 6, 8]], np.float32))
+    gt = paddle.to_tensor(
+        np.array([[1, 1, 3, 5], [0, 0, 8, 8]], np.float32))
+    enc = V.box_coder(priors, gt, "encode_center_size")
+    dec = V.box_coder(priors, enc, "decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec.data), np.asarray(gt.data),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    kept = np.asarray(V.nms(boxes, scores, iou_threshold=0.5).data)
+    np.testing.assert_array_equal(sorted(kept.tolist()), [0, 2])
+
+
+def test_multiclass_nms_and_map():
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([[0.9, 0.05, 0.8],    # class 0
+                       [0.1, 0.95, 0.02]],  # class 1
+                      np.float32)
+    det = np.asarray(V.multiclass_nms(boxes, scores,
+                                      score_threshold=0.5).data)
+    assert det.shape[1] == 6
+    classes = det[:, 0].astype(int).tolist()
+    assert sorted(classes) == [0, 0, 1]
+
+    # perfect detections -> mAP 1.0
+    gt_b = [np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)]
+    gt_l = [np.array([0, 0], np.int64)]
+    dets = [np.array([[0, 0.9, 0, 0, 10, 10],
+                      [0, 0.8, 20, 20, 30, 30]], np.float32)]
+    assert V.detection_map(dets, gt_b, gt_l) == pytest.approx(1.0)
+    # one spurious extra detection lowers it
+    dets2 = [np.vstack([dets[0],
+                        [0, 0.95, 50, 50, 60, 60]]).astype(np.float32)]
+    assert V.detection_map(dets2, gt_b, gt_l) < 1.0
+
+
+def test_prior_box_and_anchors_shapes():
+    pb = V.prior_box(2, 3, 100, 150, min_sizes=(30,), max_sizes=(60,),
+                     aspect_ratios=(1.0, 2.0), flip=True, clip=True)
+    assert pb.shape[:2] == [2, 3] and pb.shape[3] == 4
+    a = np.asarray(pb.data)
+    assert (a >= 0).all() and (a <= 1).all()
+    an = V.generate_anchors(4, 4, stride=16, sizes=(32,),
+                            aspect_ratios=(1.0,))
+    assert an.shape == [4, 4, 1, 4]
+    # centered on the stride grid
+    np.testing.assert_allclose(np.asarray(an.data)[0, 0, 0],
+                               [8 - 16, 8 - 16, 8 + 16, 8 + 16])
