@@ -54,13 +54,12 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None) -> str:
     lr = getattr(trainer.optimizer, "_lr", None)
     if isinstance(lr, LRScheduler):
         state["lr_scheduler"] = lr.state_dict()
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
+    # fs backend (reference framework/io/fs.cc): local paths write
+    # tmp+rename (atomic — a killed save never corrupts), hdfs:// paths
+    # stage locally and upload
+    from ..framework.fs import open_for_write
+    with open_for_write(path, "wb") as f:
         pickle.dump(state, f)
-    os.replace(tmp, path)  # atomic: a killed save never corrupts
     return path
 
 
@@ -87,7 +86,8 @@ def load_trainer(trainer, path: str) -> dict:
     come from the trainer, so the mesh layout may differ from the one
     that wrote the checkpoint. Returns the 'extra' metadata dict."""
     from ..optimizer.lr import LRScheduler
-    with open(path, "rb") as f:
+    from ..framework.fs import open_for_read
+    with open_for_read(path, "rb") as f:
         state = pickle.load(f)
     if state.get("format") != _FORMAT:
         raise ValueError(f"{path} is not a {_FORMAT} checkpoint")

@@ -1,0 +1,147 @@
+"""Filesystem abstraction for checkpoint/save paths — local + HDFS.
+
+Reference: /root/reference/paddle/fluid/framework/io/fs.cc (LocalFS +
+HDFS via `hadoop fs` shell commands: _get/_put/exists/mkdir) and
+python/paddle/distributed/fleet/utils/fs.py (LocalFS/HDFSClient).
+
+Scheme-dispatched: paths starting with "hdfs://" (or "afs://") go
+through the hadoop CLI, everything else is the local filesystem.  Save
+paths stage through a local temp file and upload (the reference's
+_put-on-close pattern), loads download to a temp file first — so the
+pickle/np machinery only ever sees local files.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+from contextlib import contextmanager
+from typing import List
+
+__all__ = ["LocalFS", "HadoopFS", "get_fs", "open_for_write",
+           "open_for_read"]
+
+
+class LocalFS:
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str):
+        if path:
+            os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def list_dir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path))
+
+    def put(self, local: str, dest: str):
+        self.makedirs(os.path.dirname(dest))
+        os.replace(local, dest)  # atomic on the same filesystem
+
+    def get(self, src: str, local: str):
+        shutil.copyfile(src, local)
+
+
+class HadoopFS:
+    """`hadoop fs` CLI wrapper (fs.cc ran the same commands).
+
+    The binary is taken from PADDLE_HADOOP_BIN (default "hadoop") so
+    tests and exotic installs can point at their own wrapper."""
+
+    def __init__(self):
+        self.bin = os.environ.get("PADDLE_HADOOP_BIN", "hadoop")
+
+    def _run(self, *args, check=True) -> subprocess.CompletedProcess:
+        cmd = [self.bin, "fs", *args]
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  check=check, timeout=300)
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"hadoop CLI {self.bin!r} not found; install hadoop or "
+                f"set PADDLE_HADOOP_BIN (needed for hdfs:// paths)")
+
+    def exists(self, path: str) -> bool:
+        return self._run("-test", "-e", path, check=False).returncode == 0
+
+    def makedirs(self, path: str):
+        if path:
+            self._run("-mkdir", "-p", path)
+
+    def remove(self, path: str):
+        self._run("-rm", "-r", "-f", path)
+
+    def list_dir(self, path: str) -> List[str]:
+        out = self._run("-ls", path).stdout
+        names = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                names.append(parts[-1].rsplit("/", 1)[-1])
+        return sorted(names)
+
+    def put(self, local: str, dest: str):
+        self.makedirs(dest.rsplit("/", 1)[0])
+        # -f: overwrite, the semantics of os.replace
+        self._run("-put", "-f", local, dest)
+        os.remove(local)
+
+    def get(self, src: str, local: str):
+        self._run("-get", src, local)
+
+
+_REMOTE_SCHEMES = ("hdfs://", "afs://")
+
+
+def get_fs(path: str):
+    if any(path.startswith(s) for s in _REMOTE_SCHEMES):
+        return HadoopFS()
+    return LocalFS()
+
+
+@contextmanager
+def open_for_write(path: str, mode: str = "wb"):
+    """Yield a local file handle; on clean exit the bytes land at `path`
+    atomically (local: tmp+rename; remote: tmp+put)."""
+    fs = get_fs(path)
+    if isinstance(fs, LocalFS):
+        d = os.path.dirname(path)
+        fs.makedirs(d)
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            yield f
+        os.replace(tmp, path)
+    else:
+        fd, tmp = tempfile.mkstemp(suffix=".pdtmp")
+        os.close(fd)
+        try:
+            with open(tmp, mode) as f:
+                yield f
+            fs.put(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+
+@contextmanager
+def open_for_read(path: str, mode: str = "rb"):
+    fs = get_fs(path)
+    if isinstance(fs, LocalFS):
+        with open(path, mode) as f:
+            yield f
+    else:
+        fd, tmp = tempfile.mkstemp(suffix=".pdtmp")
+        os.close(fd)
+        try:
+            fs.get(path, tmp)
+            with open(tmp, mode) as f:
+                yield f
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)

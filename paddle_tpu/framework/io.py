@@ -61,16 +61,17 @@ def _unpack(obj: Any, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    """paddle.save parity: state dicts, nested containers, single tensors."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    """paddle.save parity: state dicts, nested containers, single
+    tensors. hdfs:///afs:// paths stage through the fs backend
+    (reference framework/io/fs.cc)."""
+    from .fs import open_for_write
+    with open_for_write(path, "wb") as f:
         pickle.dump(_pack(obj), f, protocol=protocol)
 
 
 def load(path, return_numpy=False, **configs):
-    """paddle.load parity."""
-    with open(path, "rb") as f:
+    """paddle.load parity (local or remote-fs path)."""
+    from .fs import open_for_read
+    with open_for_read(path, "rb") as f:
         obj = pickle.load(f)
     return _unpack(obj, return_numpy=return_numpy)
