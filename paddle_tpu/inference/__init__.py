@@ -1,0 +1,10 @@
+"""Inference entry points.
+
+Reference: paddle/fluid/inference/ (AnalysisPredictor + capi/).  The
+TPU-native predictor is jit.load's TranslatedLayer over a serialized
+StableHLO export; this package adds the C ABI around it (capi/) so
+non-Python serving stacks can load the same artifact.
+"""
+from ..jit.api import load as load_predictor  # noqa: F401
+
+__all__ = ["load_predictor"]

@@ -1,0 +1,53 @@
+"""Build the C inference library (and optionally a demo binary).
+
+Reference: paddle/fluid/inference/capi built into libpaddle_fluid_c.so
+by cmake; here one cc invocation with python3-config's embed flags.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _embed_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    return ([f"-I{inc}"],
+            [f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm"])
+
+
+def build_library(output: str | None = None) -> str:
+    """Compile pd_inference.c -> libpd_inference.so. Returns the path."""
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        raise RuntimeError("no C compiler found (need cc/gcc/g++)")
+    out = output or os.path.join(HERE, "libpd_inference.so")
+    incs, libs = _embed_flags()
+    cmd = [cc, "-O2", "-fPIC", "-shared",
+           os.path.join(HERE, "pd_inference.c"), "-o", out,
+           *incs, *libs]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def build_demo(output: str | None = None) -> str:
+    """Compile the standalone C demo executable (capi_demo.c)."""
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        raise RuntimeError("no C compiler found")
+    out = output or os.path.join(HERE, "pd_capi_demo")
+    incs, libs = _embed_flags()
+    cmd = [cc, "-O2", os.path.join(HERE, "capi_demo.c"),
+           os.path.join(HERE, "pd_inference.c"), "-o", out,
+           f"-I{HERE}", *incs, *libs]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+if __name__ == "__main__":
+    print(build_library())

@@ -1,0 +1,64 @@
+/* Standalone C serving demo: load a jit.save export and run it.
+ *
+ * Usage: pd_capi_demo <model_path> <n_floats>
+ * Feeds [1, n] ramp input, prints the output values — proving a
+ * non-Python program can serve the StableHLO export (the role of the
+ * reference's capi tests / C predictor demos).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "pd_inference.h"
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <model_path> <n_inputs>\n", argv[0]);
+        return 2;
+    }
+    const char *path = argv[1];
+    int n = atoi(argv[2]);
+
+    PD_Predictor *pred = PD_NewPredictor(path);
+    if (!pred) {
+        fprintf(stderr, "load failed: %s\n", PD_GetLastError());
+        return 1;
+    }
+
+    float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+    for (int i = 0; i < n; i++) buf[i] = (float)i * 0.1f;
+
+    PD_Tensor in;
+    memset(&in, 0, sizeof(in));
+    in.data = buf;
+    in.ndim = 2;
+    in.shape[0] = 1;
+    in.shape[1] = n;
+    snprintf(in.dtype, sizeof(in.dtype), "float32");
+
+    PD_Tensor *outs = NULL;
+    int32_t n_outs = 0;
+    if (PD_PredictorRun(pred, &in, 1, &outs, &n_outs) != 0) {
+        fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+        return 1;
+    }
+
+    for (int t = 0; t < n_outs; t++) {
+        int64_t numel = 1;
+        for (int d = 0; d < outs[t].ndim; d++) numel *= outs[t].shape[d];
+        printf("OUT %d dtype=%s numel=%lld:", t, outs[t].dtype,
+               (long long)numel);
+        if (!strcmp(outs[t].dtype, "float32")) {
+            const float *v = (const float *)outs[t].data;
+            for (int64_t i = 0; i < numel && i < 8; i++)
+                printf(" %.6f", v[i]);
+        }
+        printf("\n");
+    }
+
+    PD_TensorsFree(outs, n_outs);
+    free(buf);
+    PD_DeletePredictor(pred);
+    printf("CAPI-DEMO-OK\n");
+    return 0;
+}

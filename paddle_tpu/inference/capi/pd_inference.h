@@ -1,0 +1,56 @@
+/* C inference API over the StableHLO export.
+ *
+ * Reference: paddle/fluid/inference/capi/pd_predictor.cc + paddle_c_api.h
+ * (PD_NewAnalysisConfig / PD_PredictorRun family).  This header is the
+ * TPU-native equivalent: the predictor behind it is a deserialized
+ * StableHLO program executed by XLA, reached through an embedded CPython
+ * (XLA itself is the runtime; Python is only the loader glue).
+ *
+ * Contract:
+ *  - PD_NewPredictor loads "<path>.pdmodel" + "<path>.pdiparams"
+ *    (paddle.jit.save artifacts).  PYTHONPATH must let the embedded
+ *    interpreter import paddle_tpu.
+ *  - Inputs are caller-owned buffers; outputs are library-allocated and
+ *    released with PD_TensorsFree.
+ *  - All functions return NULL / nonzero on failure; PD_GetLastError
+ *    returns a static description of the most recent failure.
+ */
+#ifndef PD_INFERENCE_H
+#define PD_INFERENCE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PD_MAX_DIMS 8
+
+typedef struct {
+    void *data;               /* element buffer                      */
+    int64_t shape[PD_MAX_DIMS];
+    int32_t ndim;
+    char dtype[16];           /* numpy name: "float32", "int32", ... */
+} PD_Tensor;
+
+typedef struct PD_Predictor PD_Predictor;
+
+PD_Predictor *PD_NewPredictor(const char *model_path);
+void PD_DeletePredictor(PD_Predictor *pred);
+
+/* Runs the exported program. Returns 0 on success and fills *outputs
+ * (malloc'd array of *n_outputs tensors, each with a malloc'd data
+ * buffer). */
+int PD_PredictorRun(PD_Predictor *pred,
+                    const PD_Tensor *inputs, int32_t n_inputs,
+                    PD_Tensor **outputs, int32_t *n_outputs);
+
+void PD_TensorsFree(PD_Tensor *tensors, int32_t n);
+
+const char *PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PD_INFERENCE_H */

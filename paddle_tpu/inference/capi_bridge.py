@@ -1,0 +1,51 @@
+"""Python side of the C inference API.
+
+Reference: paddle/fluid/inference/capi/pd_predictor.cc — there the C
+functions wrap the C++ AnalysisPredictor; here they wrap the XLA
+runtime (deserialized StableHLO + params via jit.load), reached through
+an embedded CPython.  The C shim (capi/pd_inference.c) calls exactly
+three functions: create / run / destroy, trafficking in raw bytes +
+shape + dtype-name triples so no numpy C API crosses the boundary.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_predictors: Dict[int, object] = {}
+_next_handle = 1
+
+
+def create(model_path: str) -> int:
+    """Load a jit.save export; returns an opaque handle."""
+    global _next_handle
+    from ..jit.api import load
+    layer = load(model_path)
+    h = _next_handle
+    _next_handle += 1
+    _predictors[h] = layer
+    return h
+
+
+def run(handle: int,
+        inputs: List[Tuple[bytes, Tuple[int, ...], str]]
+        ) -> List[Tuple[bytes, Tuple[int, ...], str]]:
+    """inputs/outputs: (raw little-endian bytes, shape, dtype name)."""
+    layer = _predictors[handle]
+    args = []
+    for raw, shape, dtype in inputs:
+        args.append(np.frombuffer(raw, dtype=np.dtype(dtype))
+                    .reshape(tuple(shape)))
+    out = layer(*args)
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    result = []
+    for leaf in leaves:
+        a = np.asarray(leaf.data if hasattr(leaf, "data") else leaf)
+        result.append((a.tobytes(), tuple(a.shape), a.dtype.name))
+    return result
+
+
+def destroy(handle: int) -> None:
+    _predictors.pop(handle, None)
