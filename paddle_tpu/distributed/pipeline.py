@@ -66,9 +66,22 @@ def stack_block_params(blocks: Sequence[Layer]) -> Dict[str, jax.Array]:
     return {k: jnp.stack([d[k].data for d in per_block]) for k in keys}
 
 
-def _call(layer: Layer, params, *args, training=True):
-    out, _ = functional_call(layer, params, {}, *args, training=training)
+def _call(layer: Layer, params, *args, training=True, buffers=None):
+    out, _ = functional_call(layer, params, buffers or {}, *args,
+                             training=training)
     return out
+
+
+def stack_block_buffers(blocks: Sequence[Layer]) -> Dict[str, jax.Array]:
+    """Stack the blocks' buffers on a leading layer axis (the buffer
+    analogue of stack_block_params)."""
+    per_block = [{n: b.data for n, b in blk.named_buffers()
+                  if b is not None} for blk in blocks]
+    keys = list(per_block[0].keys())
+    for d in per_block[1:]:
+        if list(d.keys()) != keys:
+            raise ValueError("pipeline blocks' buffer sets differ")
+    return {k: jnp.stack([d[k] for d in per_block]) for k in keys}
 
 
 class GPipeTrainer:
@@ -92,15 +105,27 @@ class GPipeTrainer:
                  num_microbatches: int = 2, pp_axis: str = "pp",
                  dp_axis: str = "dp", remat: bool = True,
                  strategy: Optional[DistributedStrategy] = None,
-                 dedupe_head: bool = True):
+                 dedupe_head: bool = True, buffer_mode: str = "forbid"):
         if pp_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no '{pp_axis}' axis")
-        for lname, l in (("pre", pre), ("post", post), ("block", blocks[0])):
-            if any(b is not None for _, b in l.named_buffers()):
-                raise NotImplementedError(
-                    f"pipeline {lname} stage has buffers; buffer-updating "
-                    f"layers (BatchNorm) are not supported in the pipeline "
-                    f"(reference SectionWorker has the same restriction)")
+        if buffer_mode not in ("forbid", "frozen"):
+            raise ValueError(
+                f"buffer_mode must be 'forbid' or 'frozen', got "
+                f"{buffer_mode!r}")
+        self.buffer_mode = buffer_mode
+        has_buffers = any(
+            b is not None
+            for l in (pre, post, blocks[0])
+            for _, b in l.named_buffers())
+        if has_buffers and buffer_mode == "forbid":
+            raise NotImplementedError(
+                "pipeline stage has buffers; buffer-UPDATING layers "
+                "(train-mode BatchNorm running stats) cannot pipeline "
+                "(reference SectionWorker has the same restriction). "
+                "Pass buffer_mode='frozen' to run them with read-only "
+                "buffers: forward math is unchanged (train-mode BN "
+                "normalizes with batch stats), but running statistics "
+                "are NOT tracked — calibrate eval stats separately.")
         # MoE routers emit aux losses; blocks and post thread them through
         # the schedule, but the pre stage runs inside the tick scan where
         # they would be dropped silently — fail loudly instead
@@ -154,6 +179,21 @@ class GPipeTrainer:
             "blocks": {n: blk_shard for n in self.params["blocks"]},
             "post": {n: repl for n in self.params["post"]},
         }
+        # read-only buffers (buffer_mode='frozen'): pre/post replicated,
+        # block buffers stacked [L, ...] and captured whole (each rank
+        # slices its slab by axis_index inside the shard_map program)
+        self._frozen_buffers = None
+        if self.buffer_mode == "frozen":
+            self._frozen_buffers = {
+                "pre": {n: jax.device_put(b.data, repl)
+                        for n, b in pre.named_buffers() if b is not None},
+                "blocks": {k: jax.device_put(v, repl)
+                           for k, v in stack_block_buffers(blocks)
+                           .items()},
+                "post": {n: jax.device_put(b.data, repl)
+                         for n, b in post.named_buffers()
+                         if b is not None},
+            }
         with jax.transfer_guard("allow"):
             opt_state = optimizer.init_state(self.params)
 
@@ -176,16 +216,18 @@ class GPipeTrainer:
         self._compiled = None
 
     # ------------------------------------------------------------------
-    def _stage_fn(self, slab, h, training):
+    def _stage_fn(self, slab, h, training, buf_slab=None):
         """Run this rank's slab of layers: inner scan over [L/S, ...].
         Returns (h, aux): aux losses (MoE routers) produced inside the
         layer scan leave it as explicit scan outputs."""
         from .moe import collect_aux_losses
 
-        def body(carry, layer_params):
+        def body(carry, xs):
+            layer_params, layer_buf = xs if buf_slab is not None \
+                else (xs, None)
             with collect_aux_losses() as aux:
                 out = _call(self.template, layer_params, carry,
-                            training=training)
+                            training=training, buffers=layer_buf)
             asum = jnp.float32(0.0)
             for a in aux:
                 asum = asum + (a.data if isinstance(a, Tensor)
@@ -194,7 +236,8 @@ class GPipeTrainer:
 
         if self.remat:
             body = jax.checkpoint(body)
-        h, auxs = jax.lax.scan(body, h, slab)
+        xs = (slab, buf_slab) if buf_slab is not None else slab
+        h, auxs = jax.lax.scan(body, h, xs)
         return h, jnp.sum(auxs)
 
     def _pipeline_forward(self, params, micro_in, micro_lab, training):
@@ -203,11 +246,21 @@ class GPipeTrainer:
         idx = jax.lax.axis_index(self.pp_axis)
         pre_p, slab, post_p = (params["pre"], params["blocks"],
                                params["post"])
+        fb = self._frozen_buffers
+        if fb is not None:
+            lps = self.num_layers // S
+            buf_slab = {k: jax.lax.dynamic_slice_in_dim(v, idx * lps,
+                                                        lps, 0)
+                        for k, v in fb["blocks"].items()} or None
+            pre_buf, post_buf = fb["pre"], fb["post"]
+        else:
+            buf_slab = pre_buf = post_buf = None
 
         def pre_fn(i):
             x = jax.lax.dynamic_index_in_dim(micro_in, i, 0,
                                              keepdims=False)
-            return _call(self.pre, pre_p, Tensor(x), training=training)
+            return _call(self.pre, pre_p, Tensor(x), training=training,
+                         buffers=pre_buf)
 
         # embed ALL microbatches once, outside the tick loop: the old
         # per-tick pre call ran the embedding M+S-1 times on every rank
@@ -219,7 +272,7 @@ class GPipeTrainer:
 
         def tick(carry, t):
             act, out_buf, aux_acc = carry
-            y, aux_t = self._stage_fn(slab, act, training)
+            y, aux_t = self._stage_fn(slab, act, training, buf_slab)
             # this rank's tick t holds microbatch (t - idx); bubble ticks
             # run on zeros and their router aux must not count
             valid = (t >= idx) & (t < idx + M)
@@ -252,7 +305,8 @@ class GPipeTrainer:
 
         def head_loss(h, lab_idx):
             """post + loss for one microbatch activation h."""
-            out = _call(self.post, post_p, Tensor(h), training=training)
+            out = _call(self.post, post_p, Tensor(h), training=training,
+                        buffers=post_buf)
             out_t = jax.tree_util.tree_map(
                 lambda a: Tensor(a, stop_gradient=True), out)
             lab = jax.tree_util.tree_map(

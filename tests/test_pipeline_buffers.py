@@ -1,0 +1,103 @@
+"""GPipe with buffer-carrying stages (BatchNorm) via buffer_mode='frozen'.
+
+Reference: the SectionWorker forbids cross-microbatch state; frozen mode
+runs buffered layers with read-only buffers (train-mode BN normalizes
+with batch stats, so the forward math is unchanged — only running-stat
+tracking is off).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import create_mesh
+from paddle_tpu.distributed.pipeline import GPipeTrainer
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+        self.bn = nn.BatchNorm1D(8)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.fc(x)))
+
+
+def build(seed=0):
+    paddle.seed(seed)
+    pre = nn.Linear(4, 8)
+    blocks = [Block() for _ in range(4)]
+    post = nn.Linear(8, 2)
+    return pre, blocks, post
+
+
+def mse(out, y):
+    return F.mse_loss(out, y)
+
+
+def batch(n=8):
+    rng = np.random.RandomState(0)
+    return (rng.randn(n, 4).astype(np.float32),
+            rng.randn(n, 2).astype(np.float32))
+
+
+def test_buffers_forbidden_by_default():
+    pre, blocks, post = build()
+    params = [p for l in (pre, post, *blocks) for p in l.parameters()]
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    with pytest.raises(NotImplementedError, match="frozen"):
+        GPipeTrainer(pre, blocks, post, opt, mse,
+                     mesh=create_mesh({"pp": 2}), num_microbatches=2)
+
+
+def test_frozen_buffers_pipeline_matches_single_device():
+    """First-step loss of the pp=2 frozen-buffer pipeline equals the
+    eager PER-MICROBATCH forward loss: BatchNorm uses batch statistics,
+    and a pipeline normalizes each microbatch separately (inherent to
+    microbatching, reference included)."""
+    x, y = batch()
+
+    pre, blocks, post = build()
+    losses = []
+    for lo in (0, 4):  # the two microbatches of 4
+        out = post(blocks[3](blocks[2](blocks[1](blocks[0](
+            pre(paddle.to_tensor(x[lo:lo + 4])))))))
+        losses.append(float(mse(out, paddle.to_tensor(y[lo:lo + 4]))))
+    eager_loss = float(np.mean(losses))
+
+    pre, blocks, post = build()
+    params = [p for l in (pre, post, *blocks) for p in l.parameters()]
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    pipe = GPipeTrainer(pre, blocks, post, opt, mse,
+                        mesh=create_mesh({"pp": 2}), num_microbatches=2,
+                        buffer_mode="frozen")
+    pipe_loss = float(pipe.train_step(x, y))
+    assert pipe_loss == pytest.approx(eager_loss, rel=1e-4)
+
+
+def test_frozen_buffers_pipeline_trains():
+    pre, blocks, post = build()
+    params = [p for l in (pre, post, *blocks) for p in l.parameters()]
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    pipe = GPipeTrainer(pre, blocks, post, opt, mse,
+                        mesh=create_mesh({"pp": 2, "dp": 2}),
+                        num_microbatches=2, buffer_mode="frozen")
+    x, y = batch(16)
+    losses = [float(pipe.train_step(x, y)) for _ in range(8)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_frozen_buffers_stay_frozen():
+    pre, blocks, post = build()
+    bn_mean_before = np.asarray(blocks[0].bn._mean.data).copy()
+    params = [p for l in (pre, post, *blocks) for p in l.parameters()]
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    pipe = GPipeTrainer(pre, blocks, post, opt, mse,
+                        mesh=create_mesh({"pp": 2}), num_microbatches=2,
+                        buffer_mode="frozen")
+    x, y = batch()
+    pipe.train_step(x, y)
+    np.testing.assert_array_equal(
+        np.asarray(blocks[0].bn._mean.data), bn_mean_before)
