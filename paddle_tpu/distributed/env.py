@@ -71,3 +71,36 @@ def init_parallel_env():
 
 def is_initialized() -> bool:
     return _initialized
+
+
+# ---------------------------------------------------------------------------
+# heartbeat (failure detection / elastic runtime)
+# ---------------------------------------------------------------------------
+_last_beat = 0.0
+
+
+def heartbeat(min_interval: float = 1.0) -> bool:
+    """Signal liveness to the launcher's watchdog (reference: the
+    elastic manager's worker heartbeat). No-op unless the launcher
+    enabled it (PADDLE_HEARTBEAT_DIR env, set by launch
+    --heartbeat_timeout); throttled to one file touch per
+    `min_interval` seconds so per-step calls cost one time() check.
+
+    Compiled trainers call this every train_step; call it yourself in
+    hand-rolled loops that go long between steps."""
+    import time as _time
+    global _last_beat
+    hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if not hb_dir:
+        return False
+    now = _time.time()
+    if now - _last_beat < min_interval:
+        return True
+    _last_beat = now
+    path = os.path.join(hb_dir, f"hb.{get_rank()}")
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        return False
+    return True

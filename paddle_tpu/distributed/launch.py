@@ -138,11 +138,26 @@ def start_local_trainers(pod: Pod, world: int, endpoints: List[str],
     return procs
 
 
+HEARTBEAT_ENV = "PADDLE_HEARTBEAT_DIR"
+RC_HEARTBEAT_LOST = 98  # pod exit code for a hung (not crashed) trainer
+
+
+def heartbeat_path(hb_dir: str, rank: int) -> str:
+    return os.path.join(hb_dir, f"hb.{rank}")
+
+
 def watch_local_trainers(procs: List[TrainerProc],
-                         poll_interval: float = 0.5) -> int:
+                         poll_interval: float = 0.5,
+                         heartbeat_dir: Optional[str] = None,
+                         heartbeat_timeout: float = 0.0) -> int:
     """Tear the pod down when any trainer dies (reference
-    watch_local_trainers, launch_utils.py:526). Returns the pod's exit
-    code (first non-zero child, else 0)."""
+    watch_local_trainers, launch_utils.py:526) — or, with heartbeats
+    enabled, when any trainer goes silent for heartbeat_timeout seconds
+    (the failure-detection role of the reference's elastic manager; a
+    rank hung in a dead collective never exits on its own).  Returns the
+    pod's exit code (first non-zero child, RC_HEARTBEAT_LOST for hangs,
+    else 0)."""
+    start = time.time()
     try:
         while True:
             alive, rc = 0, 0
@@ -157,6 +172,25 @@ def watch_local_trainers(procs: List[TrainerProc],
                 return rc
             if alive == 0:
                 return 0
+            if heartbeat_dir and heartbeat_timeout > 0:
+                now = time.time()
+                for t in procs:
+                    if t.proc.poll() is not None:
+                        continue
+                    p = heartbeat_path(heartbeat_dir, t.rank)
+                    try:
+                        last = os.path.getmtime(p)
+                    except OSError:
+                        # no beat yet: measure from launch (startup +
+                        # first compile count against the same budget)
+                        last = start
+                    if now - last > heartbeat_timeout:
+                        print(f"launch: rank {t.rank} heartbeat lost "
+                              f"({now - last:.0f}s > "
+                              f"{heartbeat_timeout:.0f}s); tearing down",
+                              file=sys.stderr, flush=True)
+                        _terminate(procs)
+                        return RC_HEARTBEAT_LOST
             time.sleep(poll_interval)
     except KeyboardInterrupt:  # pragma: no cover
         _terminate(procs)
@@ -189,30 +223,63 @@ def launch(args=None) -> int:
                         help="comma-separated host ips")
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("--start_port", type=int, default=None)
+    parser.add_argument("--elastic_retries", type=int, default=0,
+                        help="relaunch the whole pod up to N times after "
+                             "a crash or lost heartbeat (pair with "
+                             "checkpoint auto-resume for fault-tolerant "
+                             "training)")
+    parser.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                        help="seconds of trainer silence before the pod "
+                             "is declared hung (0 = disabled); trainers "
+                             "beat automatically from train_step")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     a = parser.parse_args(args)
 
     ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
-    endpoints, pods = get_cluster(ips, a.nproc_per_node, a.start_port)
-    # pick THIS host's pod (reference matches the node ip); each host of
-    # a multi-host cluster runs its own launcher over the same --ips
-    if len(pods) == 1:
-        pod = pods[0]
-    else:
-        local = _local_addrs(probe_ips=ips)
-        mine = [p for p in pods if p.addr in local]
-        if not mine:
-            raise SystemExit(
-                f"none of --ips {ips} matches this host "
-                f"({sorted(local)}); include this host's ip")
-        pod = mine[0]
-    coordinator = f"{ips[0]}:{find_free_port()}" if ips[0] in (
-        "127.0.0.1", "localhost") else endpoints[0]
-    procs = start_local_trainers(pod, len(endpoints), endpoints,
-                                 coordinator, a.training_script,
-                                 a.script_args, a.log_dir)
-    return watch_local_trainers(procs)
+
+    attempts = a.elastic_retries + 1
+    for attempt in range(attempts):
+        # fresh ports each attempt: the dead pod's sockets may linger
+        endpoints, pods = get_cluster(ips, a.nproc_per_node, a.start_port)
+        # pick THIS host's pod (reference matches the node ip); each host
+        # of a multi-host cluster runs its own launcher over the same
+        # --ips
+        if len(pods) == 1:
+            pod = pods[0]
+        else:
+            local = _local_addrs(probe_ips=ips)
+            mine = [p for p in pods if p.addr in local]
+            if not mine:
+                raise SystemExit(
+                    f"none of --ips {ips} matches this host "
+                    f"({sorted(local)}); include this host's ip")
+            pod = mine[0]
+        coordinator = f"{ips[0]}:{find_free_port()}" if ips[0] in (
+            "127.0.0.1", "localhost") else endpoints[0]
+
+        hb_dir = None
+        if a.heartbeat_timeout > 0:
+            hb_dir = a.log_dir or os.path.join(
+                os.environ.get("TMPDIR", "/tmp"),
+                f"paddle_hb_{os.getpid()}_{attempt}")
+            os.makedirs(hb_dir, exist_ok=True)
+            os.environ[HEARTBEAT_ENV] = hb_dir  # inherited by children
+
+        procs = start_local_trainers(pod, len(endpoints), endpoints,
+                                     coordinator, a.training_script,
+                                     a.script_args, a.log_dir)
+        rc = watch_local_trainers(procs,
+                                  heartbeat_dir=hb_dir,
+                                  heartbeat_timeout=a.heartbeat_timeout)
+        if rc == 0:
+            return 0
+        if attempt + 1 < attempts:
+            print(f"launch: pod failed (rc={rc}); elastic restart "
+                  f"{attempt + 2}/{attempts}", file=sys.stderr,
+                  flush=True)
+            time.sleep(1.0)
+    return rc
 
 
 if __name__ == "__main__":

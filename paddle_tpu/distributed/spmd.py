@@ -607,6 +607,8 @@ class SpmdTrainer:
         sync — call float() when you actually need the number); with
         return_outputs=True returns (loss, outputs) — the forward outputs
         ride along for metric computation (hapi)."""
+        from . import env as _env
+        _env.heartbeat()  # launcher watchdog liveness (no-op if unset)
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
         labels = labels if isinstance(labels, (tuple, list)) else (labels,)
         batch = self.shard_batch(tuple(inputs) + tuple(labels))

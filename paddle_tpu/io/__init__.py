@@ -19,3 +19,4 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler)
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .in_memory import InMemoryDataset  # noqa: F401

@@ -1,0 +1,139 @@
+"""Sequence decoding: beam search, greedy, sampling + gather_tree.
+
+Reference: /root/reference/paddle/fluid/operators/beam_search_op.h
+(per-step top-k over K*V candidates with parent pointers),
+beam_search_decode_op (backtracking), gather_tree_op.cc, and the Python
+orchestration in fluid/layers/rnn.py (BeamSearchDecoder +
+dynamic_decode).
+
+TPU-native shape: the whole decode is ONE lax.scan over time — the
+per-step top-k, parent gather, and finished masking are fixed-shape jnp
+ops, so the entire loop compiles to a single XLA while-program (the
+reference re-enters the executor per step).  States carry a leading
+[B*K] dim; `step_fn(tokens, state) -> (logits, state)` is any jax
+function (e.g. a transformer step with a KV cache pytree).
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["beam_search", "greedy_search", "gather_tree"]
+
+_NEG = -1e9
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def gather_tree(token_ids, parent_ids):
+    """Backtrack beam parent pointers into full sequences
+    (gather_tree_op.cc). token_ids/parent_ids: [T, B, K] -> [T, B, K]
+    where output[:, b, k] is the COMPLETE sequence feeding beam k at the
+    final step."""
+    ids = _arr(token_ids)
+    parents = _arr(parent_ids)
+    T = ids.shape[0]
+
+    def back(carry, t):
+        beam = carry                               # [B, K] current beam
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        par = jnp.take_along_axis(parents[t], beam, axis=1)
+        return par, tok
+
+    k0 = jnp.broadcast_to(jnp.arange(ids.shape[2])[None, :],
+                          ids.shape[1:])
+    _, toks = jax.lax.scan(back, k0, jnp.arange(T - 1, -1, -1))
+    return Tensor(toks[::-1])
+
+
+def beam_search(step_fn: Callable, init_state, batch_size: int,
+                beam_size: int, max_len: int, bos_id: int, eos_id: int,
+                length_penalty: float = 0.0) -> Tuple[Tensor, Tensor]:
+    """Compiled beam search. Returns (sequences [B, K, max_len],
+    scores [B, K]) sorted best-first.
+
+    step_fn(tokens [B*K], state) -> (logits [B*K, V], new_state); state
+    leaves carry a leading B*K dim (tile your encoder state K times).
+    length_penalty: GNMT alpha — scores divided by ((5+len)/6)^alpha.
+    """
+    B, K = batch_size, beam_size
+
+    def expand_logp(logits):
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    def step(carry, _):
+        tokens, cum, finished, state = carry      # [B,K], [B,K], [B,K]
+        logits, state = step_fn(tokens.reshape(-1), state)
+        V = logits.shape[-1]
+        logp = expand_logp(logits).reshape(B, K, V)
+        # finished beams emit ONLY eos at no cost (the reference keeps
+        # them alive in the beam with frozen scores)
+        eos_only = jnp.full((V,), _NEG).at[eos_id].set(0.0)
+        logp = jnp.where(finished[..., None], eos_only[None, None, :],
+                         logp)
+        total = cum[..., None] + logp             # [B, K, V]
+        flat = total.reshape(B, K * V)
+        cum_new, idx = jax.lax.top_k(flat, K)     # [B, K]
+        parent = idx // V
+        token = idx % V
+        finished = jnp.take_along_axis(finished, parent, axis=1) | \
+            (token == eos_id)
+        state = jax.tree_util.tree_map(
+            lambda a: a.reshape((B, K) + a.shape[1:])[
+                jnp.arange(B)[:, None], parent].reshape(
+                    (B * K,) + a.shape[1:]),
+            state)
+        return (token, cum_new, finished, state), (token, parent)
+
+    tokens0 = jnp.full((B, K), bos_id, jnp.int32)
+    # only beam 0 is live at t=0, or every beam would decode identically
+    cum0 = jnp.tile(jnp.asarray([0.0] + [_NEG] * (K - 1),
+                                jnp.float32)[None, :], (B, 1))
+    fin0 = jnp.zeros((B, K), bool)
+    (tokens, cum, finished, _), (toks, parents) = jax.lax.scan(
+        step, (tokens0, cum0, fin0, init_state), None, length=max_len)
+
+    seqs = gather_tree(toks, parents).data        # [T, B, K]
+    seqs = jnp.moveaxis(seqs, 0, 2)               # [B, K, T]
+    # length penalty at final ranking (fluid/layers/rnn.py
+    # BeamSearchDecoder's GNMT score)
+    lengths = jnp.minimum(
+        jnp.argmax((seqs == eos_id).astype(jnp.int32), axis=2) + 1,
+        max_len).astype(jnp.float32)
+    has_eos = (seqs == eos_id).any(axis=2)
+    lengths = jnp.where(has_eos, lengths, float(max_len))
+    denom = ((5.0 + lengths) / 6.0) ** length_penalty
+    scores = cum / denom
+    order = jnp.argsort(-scores, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return Tensor(seqs), Tensor(scores)
+
+
+def greedy_search(step_fn: Callable, init_state, batch_size: int,
+                  max_len: int, bos_id: int, eos_id: int
+                  ) -> Tensor:
+    """Greedy argmax decode as one lax.scan. Returns [B, max_len]."""
+    B = batch_size
+
+    def step(carry, _):
+        tokens, finished, state = carry
+        logits, state = step_fn(tokens, state)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, eos_id, nxt)
+        finished = finished | (nxt == eos_id)
+        return (nxt, finished, state), nxt
+
+    tokens0 = jnp.full((B,), bos_id, jnp.int32)
+    fin0 = jnp.zeros((B,), bool)
+    _, toks = jax.lax.scan(step, (tokens0, fin0, init_state), None,
+                           length=max_len)
+    return Tensor(jnp.moveaxis(toks, 0, 1))

@@ -1,0 +1,155 @@
+"""InMemoryDataset (CTR slot dataset) + elastic/heartbeat launcher.
+
+Reference: paddle/fluid/framework/data_set.h:157 (InMemoryDataset with
+local/global shuffle over slot records) and the fleet elastic manager's
+crash-restart + heartbeat failure detection.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import InMemoryDataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- InMemoryDataset ------------------------------------------------------
+def write_slot_file(path, lines):
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_inmemory_parse_and_batches(tmp_path):
+    p = str(tmp_path / "part-0")
+    write_slot_file(p, [
+        "1 click:3 click:7 q:11 dense:0.5 dense:1.5",
+        "0 click:3 dense:2.5 dense:3.5",
+        "1 q:4 q:5 q:6 dense:4.5 dense:5.5",
+    ])
+    ds = InMemoryDataset(dense_slots={"dense": 2}, batch_size=2)
+    ds.load_into_memory([p])
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds.batch_generator())
+    assert len(batches) == 2
+    b0 = batches[0]
+    np.testing.assert_array_equal(b0["label"].reshape(-1), [1, 0])
+    np.testing.assert_array_equal(b0["dense"],
+                                  [[0.5, 1.5], [2.5, 3.5]])
+    np.testing.assert_array_equal(b0["click"], [[3, 7], [3, -1]])
+    np.testing.assert_array_equal(b0["click@len"], [2, 1])
+    np.testing.assert_array_equal(b0["q"], [[11], [-1]])
+
+
+def test_inmemory_local_shuffle_deterministic():
+    recs = [{"label": [i], "s": [i]} for i in range(20)]
+    a = InMemoryDataset()
+    a.set_records(list(recs))
+    a.local_shuffle(seed=7)
+    b = InMemoryDataset()
+    b.set_records(list(recs))
+    b.local_shuffle(seed=7)
+    assert [r["s"] for r in a._records] == [r["s"] for r in b._records]
+    assert [r["s"] for r in a._records] != [r["s"] for r in recs]
+
+
+def test_inmemory_global_shuffle_partitions_exactly():
+    recs = [{"label": [i], "s": [i]} for i in range(50)]
+    shards = []
+    for rank in range(3):
+        ds = InMemoryDataset()
+        ds.set_records(list(recs))  # every trainer loads the full set
+        ds.global_shuffle(rank=rank, world=3, seed=1)
+        shards.append(sorted(r["s"][0] for r in ds._records))
+    all_ids = sorted(i for s in shards for i in s)
+    assert all_ids == list(range(50))          # exact partition
+    assert all(len(s) > 0 for s in shards)     # roughly spread
+
+
+def test_inmemory_use_slots_filter_and_release():
+    ds = InMemoryDataset(use_slots=["a"])
+    rec = ds.parse_line("1 a:5 b:9")
+    assert rec == {"label": [1.0], "a": [5]}
+    ds.set_records([rec])
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+# ---- elastic launcher -----------------------------------------------------
+CRASH_ONCE = textwrap.dedent("""\
+    import os, sys
+    # crash on the first pod attempt, succeed on the second: the marker
+    # file records that attempt 1 happened
+    marker = os.environ["CRASH_MARKER"]
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    if not os.path.exists(marker):
+        if rank == "0":
+            open(marker, "w").write("died")
+            sys.exit(3)
+    print(f"RANK {rank} OK", flush=True)
+""")
+
+HANG = textwrap.dedent("""\
+    import os, time
+    from paddle_tpu.distributed import env
+    env.heartbeat()          # one beat...
+    time.sleep(3600)         # ...then silence (simulated dead collective)
+""")
+
+
+@pytest.mark.slow
+def test_elastic_restart_after_crash(tmp_path):
+    script = tmp_path / "payload.py"
+    script.write_text(CRASH_ONCE)
+    marker = str(tmp_path / "crashed")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CRASH_MARKER"] = marker
+    log_dir = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_retries", "2",
+         "--log_dir", log_dir, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode} stderr={proc.stderr}"
+    assert "elastic restart" in proc.stderr
+    assert os.path.exists(marker)  # first attempt really crashed
+
+
+@pytest.mark.slow
+def test_heartbeat_timeout_detects_hang(tmp_path):
+    script = tmp_path / "payload.py"
+    script.write_text(HANG)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    log_dir = str(tmp_path / "logs")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--heartbeat_timeout", "5",
+         "--log_dir", log_dir, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    from paddle_tpu.distributed.launch import RC_HEARTBEAT_LOST
+    assert proc.returncode == RC_HEARTBEAT_LOST, \
+        f"rc={proc.returncode} stderr={proc.stderr}"
+    assert "heartbeat lost" in proc.stderr
+    assert time.time() - t0 < 120  # detected the hang, not the timeout
+
+
+def test_heartbeat_noop_without_env(monkeypatch):
+    from paddle_tpu.distributed import env as denv
+    monkeypatch.delenv("PADDLE_HEARTBEAT_DIR", raising=False)
+    assert denv.heartbeat() is False
+
+
+def test_heartbeat_touches_file(tmp_path, monkeypatch):
+    from paddle_tpu.distributed import env as denv
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setattr(denv, "_last_beat", 0.0)
+    assert denv.heartbeat() is True
+    assert os.path.exists(str(tmp_path / "hb.0"))
