@@ -77,6 +77,9 @@ def __getattr__(name):
     if name == "load":
         from .framework.io import load as _load
         return _load
+    if name in ("enable_static", "disable_static", "in_static_mode"):
+        from . import static as _static
+        return getattr(_static, name)
     if name == "summary":
         from .hapi.model_summary import summary as _summary
         return _summary
@@ -102,10 +105,15 @@ def in_dynamic_mode():
 
 
 def disable_static(place=None):
-    return None
+    """Leave static-graph mode (reference paddle.disable_static)."""
+    from .static import disable_static as _ds
+    return _ds()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no separate static mode; use paddle_tpu.jit.to_static "
-        "to compile (XLA traces and compiles the whole step).")
+    """Enter static-graph mode: paddle.static.data declares symbolic
+    inputs, ops record onto the default Program, and
+    paddle.static.Executor runs the captured graph (see
+    static/program.py)."""
+    from .static import enable_static as _es
+    return _es()

@@ -121,6 +121,14 @@ class GradNode:
         return f"<GradNode {self.name or 'op'} id={self.id}>"
 
 
+def _static_mode_on() -> bool:
+    """Fast check for paddle.enable_static without importing the static
+    package on the eager hot path."""
+    import sys
+    mod = sys.modules.get("paddle_tpu.static.program")
+    return mod is not None and mod.in_static_mode()
+
+
 def _check_nan_inf(arrs, name):
     # FLAGS_check_nan_inf parity (reference nan_inf_utils_detail.cc:293).
     # Eager values only: under a jit trace the values are symbolic —
@@ -141,8 +149,18 @@ def apply(fn, *args, name: str = ""):
     any input Tensor wants gradients. Non-Tensor args pass through
     undifferentiated. Returns Tensor or tuple of Tensors mirroring fn's
     output structure.
+
+    Static mode (paddle.enable_static): ops over static Variables record
+    graph nodes onto the default Program instead of executing — the
+    trace-based replacement for the reference's op-desc append.
     """
     from .tensor import Tensor
+
+    if _static_mode_on():
+        from ..static.program import maybe_record
+        rec = maybe_record(fn, args, name)
+        if rec is not None:
+            return rec
 
     arrs = tuple(a.data if isinstance(a, Tensor) else a for a in args)
 

@@ -193,7 +193,16 @@ class Optimizer:
         opt.minimize(loss)` must not run backward twice. A fresh backward
         runs here only when none happened for THIS optimizer's parameters
         since its last minimize (a global backward counter would let a
-        second model's backward mask this one's stale grads)."""
+        second model's backward mask this one's stale grads).
+
+        Static mode: a symbolic loss records the train hook on the
+        default Program (reference static minimize appended backward +
+        optimizer ops); Executor.run then executes the fused step."""
+        from ..static.program import Variable, default_main_program, \
+            install_minimize
+        if isinstance(loss, Variable):
+            install_minimize(default_main_program(), loss, self)
+            return None, []
         self._ensure_fresh_grads(loss)
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]

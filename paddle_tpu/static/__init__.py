@@ -12,6 +12,10 @@ from ..core.dtype import convert_dtype
 
 from . import control_flow as _cf  # noqa: E402
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from .program import (  # noqa: F401
+    Executor, Program, Variable, default_main_program,
+    default_startup_program, disable_static, enable_static,
+    in_static_mode, program_guard)
 
 
 class nn:
@@ -24,7 +28,10 @@ class nn:
 
 
 __all__ = ["InputSpec", "data", "cond", "while_loop", "case",
-           "switch_case", "nn"]
+           "switch_case", "nn", "Executor", "Program", "Variable",
+           "program_guard", "default_main_program",
+           "default_startup_program", "enable_static", "disable_static",
+           "in_static_mode"]
 
 
 class InputSpec:
@@ -56,6 +63,10 @@ class InputSpec:
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """paddle.static.data parity -> an InputSpec (graph inputs are just
-    traced function arguments here)."""
+    """paddle.static.data parity. In static mode (enable_static) this
+    declares a symbolic graph input on the default Program; otherwise it
+    returns an InputSpec (trace-export signature use, e.g. jit.save)."""
+    if in_static_mode():
+        from .program import record_data
+        return record_data(name, shape, convert_dtype(dtype))
     return InputSpec(shape, dtype, name)

@@ -1,0 +1,436 @@
+"""Static-graph surface: Program / Variable / Executor / program_guard.
+
+Reference: python/paddle/fluid/framework.py (Program:4127, Variable:978,
+program/unique-name guards), executor.py:475 (Executor.run with
+feed/fetch), and the classic static workflow
+
+    paddle.enable_static()
+    x = paddle.static.data('x', [None, 4])
+    loss = mean(net(x))
+    sgd.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    exe.run(main_program, feed={'x': a}, fetch_list=[loss])
+
+TPU-native design: there is no op-desc IR — with static mode enabled,
+every op that flows through core.autograd.apply records a NODE (the op's
+jax function + its symbolic/captured inputs) onto the default Program
+instead of executing.  Executor.run topologically re-executes the
+recorded graph as ONE jit-compiled function per (program, fetch, feed
+shapes): parameters enter as arguments (not baked constants), so
+optimizer updates — recorded by Optimizer.minimize on a symbolic loss —
+run inside the same executable, exactly the fused train step the
+ParallelExecutor analogue uses.  Shapes declared None are dynamic: the
+graph re-traces per concrete feed shape.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Variable", "Program", "Executor", "program_guard",
+           "default_main_program", "default_startup_program",
+           "enable_static", "disable_static", "in_static_mode"]
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "mode"):
+        _state.mode = False
+        _state.main = Program()
+        _state.startup = Program()
+    return _state
+
+
+def enable_static():
+    _tls().mode = True
+
+
+def disable_static():
+    _tls().mode = False
+
+
+def in_static_mode() -> bool:
+    return getattr(_state, "mode", False)
+
+
+def default_main_program() -> "Program":
+    return _tls().main
+
+
+def default_startup_program() -> "Program":
+    return _tls().startup
+
+
+class program_guard:
+    """reference fluid.program_guard: swap the default programs."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        t = _tls()
+        self._saved = (t.main, t.startup)
+        t.main = self.main
+        if self.startup is not None:
+            t.startup = self.startup
+        return self.main
+
+    def __exit__(self, *exc):
+        t = _tls()
+        t.main, t.startup = self._saved
+        return False
+
+
+class Variable:
+    """Symbolic graph value (reference framework.py Variable). Produced
+    by static.data (graph input) or by a recorded op."""
+
+    _counter = 0
+
+    def __init__(self, shape, dtype, name=None, producer=None,
+                 out_index=0):
+        if name is None:
+            Variable._counter += 1
+            name = f"_var_{Variable._counter}"
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.producer = producer          # _Node or None (graph input)
+        self.out_index = out_index
+        self.stop_gradient = True
+
+    # a minimal operator surface; everything routes through the public
+    # ops, which record via apply()
+    def _binop(self, other, opname):
+        from .. import tensor as T
+        return getattr(T, opname)(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "subtract")
+
+    def __mul__(self, o):
+        return self._binop(o, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "divide")
+
+    def __pow__(self, o):
+        return self._binop(o, "pow")
+
+    def __neg__(self):
+        from .. import tensor as T
+        return T.scale(self, -1.0)
+
+    def __matmul__(self, o):
+        from .. import tensor as T
+        return T.matmul(self, o)
+
+    def sum(self, axis=None, keepdim=False):
+        from .. import tensor as T
+        return T.sum(self, axis=axis, keepdim=keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        from .. import tensor as T
+        return T.mean(self, axis=axis, keepdim=keepdim)
+
+    def reshape(self, shape):
+        from .. import tensor as T
+        return T.reshape(self, shape)
+
+    def astype(self, dtype):
+        from .. import tensor as T
+        return T.cast(self, dtype)
+
+    def __repr__(self):
+        kind = "data" if self.producer is None else "op"
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, {kind})")
+
+
+class _Node:
+    """One recorded op: fn over (Variable | captured Tensor | constant)
+    inputs, with n_outputs Variables."""
+
+    __slots__ = ("fn", "inputs", "name", "outputs", "multi")
+
+    def __init__(self, fn, inputs, name, multi):
+        self.fn = fn
+        self.inputs = inputs          # list of Variable/Tensor/raw
+        self.name = name
+        self.multi = multi
+        self.outputs: List[Variable] = []
+
+
+class Program:
+    """An ordered op list + the training hook minimize() installs."""
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.inputs: Dict[str, Variable] = {}
+        # (loss_var, [(param_tensor, name)], optimizer) once minimize ran
+        self._train: Optional[Tuple] = None
+        self._version = 0
+
+    def _add_input(self, var: Variable):
+        self.inputs[var.name] = var
+        self._version += 1
+
+    def _add_node(self, node: _Node):
+        self.nodes.append(node)
+        self._version += 1
+
+    def global_block(self):
+        return self  # block surface: vars/ops of the single block
+
+    @property
+    def ops(self):
+        return self.nodes
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.inputs = dict(self.inputs)
+        if not for_test:
+            p._train = copy.copy(self._train)
+        return p
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.nodes)}, "
+                f"inputs={sorted(self.inputs)})")
+
+
+def record_data(name, shape, dtype) -> Variable:
+    var = Variable(shape, dtype, name=name)
+    default_main_program()._add_input(var)
+    return var
+
+
+def maybe_record(fn, args, name, amp_cast=None):
+    """Called from core.autograd.apply when static mode is on and any
+    arg is a Variable. Returns the output Variable(s) or None."""
+    from ..core.tensor import Tensor
+
+    if not any(isinstance(a, Variable) for a in args):
+        return None
+
+    node = _Node(fn, list(args), name, multi=False)
+
+    def aval(a):
+        if isinstance(a, Variable):
+            shape = tuple(1 if s in (None, -1) else int(s)
+                          for s in a.shape)
+            return jax.ShapeDtypeStruct(shape, a.dtype)
+        if isinstance(a, Tensor):
+            return jax.ShapeDtypeStruct(tuple(a.data.shape), a.data.dtype)
+        return a
+
+    out = jax.eval_shape(fn, *[aval(a) for a in args])
+    multi = isinstance(out, (tuple, list))
+    node.multi = multi
+    outs = tuple(out) if multi else (out,)
+    out_vars = tuple(
+        Variable(o.shape, o.dtype, producer=node, out_index=i)
+        for i, o in enumerate(outs))
+    node.outputs = list(out_vars)
+    default_main_program()._add_node(node)
+    return out_vars if multi else out_vars[0]
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+def _collect(fetch_vars: Sequence[Variable]):
+    """Topo-order the subgraph feeding the fetches; returns (nodes,
+    captured tensor list, input variables)."""
+    from ..core.tensor import Tensor
+    nodes, caps, inputs = [], [], []
+    seen_nodes, seen_caps, seen_inputs = set(), set(), set()
+
+    def visit_var(v: Variable):
+        if v.producer is None:
+            if id(v) not in seen_inputs:
+                seen_inputs.add(id(v))
+                inputs.append(v)
+            return
+        visit_node(v.producer)
+
+    def visit_node(n: _Node):
+        if id(n) in seen_nodes:
+            return
+        seen_nodes.add(id(n))
+        for a in n.inputs:
+            if isinstance(a, Variable):
+                visit_var(a)
+            elif isinstance(a, Tensor) and id(a) not in seen_caps:
+                seen_caps.add(id(a))
+                caps.append(a)
+        nodes.append(n)
+
+    for v in fetch_vars:
+        visit_var(v)
+    return nodes, caps, inputs
+
+
+def _run_graph(nodes, caps, inputs, fetch_vars, cap_arrays, feed_arrays):
+    """Execute the recorded ops over concrete arrays (jit-traceable)."""
+    from ..core.tensor import Tensor
+    env: Dict[int, Any] = {}
+    for v, a in zip(inputs, feed_arrays):
+        env[id(v)] = a
+    cap_env = {id(t): a for t, a in zip(caps, cap_arrays)}
+
+    for n in nodes:
+        vals = []
+        for a in n.inputs:
+            if isinstance(a, Variable):
+                vals.append(env[id(a)])
+            elif isinstance(a, Tensor):
+                vals.append(cap_env[id(a)])
+            else:
+                vals.append(a)
+        out = n.fn(*vals)
+        outs = tuple(out) if n.multi else (out,)
+        for v, o in zip(n.outputs, outs):
+            env[id(v)] = o
+    return [env[id(v)] for v in fetch_vars]
+
+
+class Executor:
+    """reference executor.py Executor: run(program, feed, fetch_list).
+    The jitted graph runner is cached per (program version, fetches,
+    feed shapes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not fetch_list and not program._train and not program.nodes:
+            return []  # startup program: params already initialized
+
+        train = program._train
+        loss_var = train[0] if train else None
+        fetch_vars = [v for v in fetch_list]
+        for v in fetch_vars:
+            if not isinstance(v, Variable):
+                raise TypeError(f"fetch_list entries must be static "
+                                f"Variables, got {type(v)}")
+        roots = fetch_vars + ([loss_var] if train else [])
+        nodes, caps, input_vars = _collect(roots)
+        missing = [v.name for v in input_vars if v.name not in feed]
+        if missing:
+            raise ValueError(f"feed is missing graph inputs: {missing}")
+        feed_arrays = [jnp.asarray(feed[v.name]) for v in input_vars]
+
+        key = (id(program), program._version,
+               tuple(id(v) for v in roots),
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+               bool(train))
+        runner = self._cache.get(key)
+        if runner is None:
+            runner = self._build(program, nodes, caps, input_vars,
+                                 fetch_vars, train)
+            self._cache[key] = runner
+        outs = runner(caps, feed_arrays)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def _build(self, program, nodes, caps, input_vars, fetch_vars,
+               train):
+        if not train:
+            fn = jax.jit(
+                lambda cap_arrays, feed_arrays: _run_graph(
+                    nodes, caps, input_vars, fetch_vars, cap_arrays,
+                    feed_arrays))
+
+            def run_infer(cap_tensors, feed_arrays):
+                return fn([t.data for t in cap_tensors], feed_arrays)
+            return run_infer
+
+        loss_var, params, optimizer = train
+        param_ids = {id(p) for p, _ in params}
+        # captured tensors that are NOT trained stay constants-by-ref
+        frozen = [t for t in caps if id(t) not in param_ids]
+        trained = [p for p, _ in params if any(id(p) == id(c)
+                                               for c in caps)]
+
+        def step(param_arrays, opt_state, frozen_arrays, feed_arrays):
+            fz = {id(t): a for t, a in zip(frozen, frozen_arrays)}
+
+            def loss_of(p_arrays):
+                tr = {id(p): a for p, a in zip(trained, p_arrays)}
+                ca = [tr.get(id(t), fz.get(id(t))) for t in caps]
+                vals = _run_graph(nodes, caps, input_vars,
+                                  fetch_vars + [loss_var], ca,
+                                  feed_arrays)
+                return vals[-1].astype(jnp.float32).sum(), vals[:-1]
+
+            (_, fetches), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(param_arrays))
+            new_params, new_state = [], []
+            for i, (p, g, s) in enumerate(zip(trained, grads, opt_state)):
+                optimizer._cur_param_name = p.name
+                optimizer._cur_param = p
+                g = optimizer._apply_decay(param_arrays[i], g, p)
+                np_, ns_ = optimizer._update(
+                    param_arrays[i], g, s, optimizer.get_lr(),
+                    optimizer._step_count + 1)
+                new_params.append(np_.astype(param_arrays[i].dtype))
+                new_state.append(ns_)
+            return new_params, new_state, fetches
+
+        jit_step = jax.jit(step)
+
+        def run_train(cap_tensors, feed_arrays):
+            # accumulators live on the optimizer, like eager step()
+            state = []
+            for p in trained:
+                key = p.name
+                if key not in optimizer._accumulators:
+                    optimizer._accumulators[key] = \
+                        optimizer._init_accumulators(p.data)
+                state.append(optimizer._accumulators[key])
+            new_params, new_state, fetches = jit_step(
+                [p.data for p in trained], state,
+                [t.data for t in frozen], feed_arrays)
+            for p, a, s in zip(trained, new_params, new_state):
+                p._data = a
+                optimizer._accumulators[p.name] = s
+            optimizer._step_count += 1
+            return fetches
+        return run_train
+
+
+def install_minimize(program: Program, loss: Variable, optimizer):
+    """Optimizer.minimize(symbolic loss) lands here: record the training
+    hook (reference: minimize appended backward + optimizer ops)."""
+    nodes, caps, _ = _collect([loss])
+    from ..core.tensor import Parameter
+    params = [(t, t.name) for t in caps
+              if isinstance(t, Parameter) and t.trainable]
+    if not params:
+        raise ValueError(
+            "minimize(loss): no trainable Parameters feed this loss")
+    program._train = (loss, params, optimizer)
+    program._version += 1

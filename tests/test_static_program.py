@@ -1,0 +1,176 @@
+"""Static-graph surface: Program/Variable/Executor/program_guard.
+
+Reference: fluid/framework.py Program:4127 + executor.py:475 — the
+classic enable_static workflow: declare data, build layers, minimize,
+then Executor.run(feed, fetch_list) in a loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def static_mode_guard():
+    """Each test gets fresh default programs and leaves eager mode on."""
+    from paddle_tpu.static import program as prog
+    prog._state.mode = False
+    prog._state.main = static.Program()
+    prog._state.startup = static.Program()
+    yield
+    prog._state.mode = False
+    prog._state.main = static.Program()
+    prog._state.startup = static.Program()
+
+
+def test_data_records_inputs_and_ops():
+    paddle.enable_static()
+    x = static.data("x", [None, 4])
+    assert isinstance(x, static.Variable)
+    y = paddle.add(x, x)
+    assert isinstance(y, static.Variable)
+    main = static.default_main_program()
+    assert "x" in main.inputs
+    assert len(main.ops) == 1
+    paddle.disable_static()
+    # eager mode restored: data() yields InputSpec again
+    assert not isinstance(static.data("z", [2]), static.Variable)
+
+
+def test_executor_runs_forward_graph():
+    paddle.enable_static()
+    x = static.data("x", [None, 3])
+    y = (x * 2.0 + 1.0).sum(axis=1)
+    exe = static.Executor()
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    (out,) = exe.run(feed={"x": a}, fetch_list=[y])
+    np.testing.assert_allclose(out, (a * 2 + 1).sum(1))
+    # a different feed shape re-traces transparently
+    b = np.ones((5, 3), np.float32)
+    (out2,) = exe.run(feed={"x": b}, fetch_list=[y])
+    np.testing.assert_allclose(out2, np.full(5, 9.0))
+
+
+def test_executor_missing_feed_raises():
+    paddle.enable_static()
+    x = static.data("x", [None, 2])
+    y = x + 1.0
+    with pytest.raises(ValueError, match="missing graph inputs"):
+        static.Executor().run(feed={}, fetch_list=[y])
+
+
+def test_layers_capture_parameters_not_constants():
+    """Captured Parameters are read at run time: mutating the weight
+    between runs changes the output (the reference's scope semantics)."""
+    paddle.enable_static()
+    lin = nn.Linear(2, 1, bias_attr=False)
+    x = static.data("x", [None, 2])
+    y = lin(x)
+    exe = static.Executor()
+    a = np.ones((1, 2), np.float32)
+    (o1,) = exe.run(feed={"x": a}, fetch_list=[y])
+    lin.weight._data = lin.weight.data * 2
+    (o2,) = exe.run(feed={"x": a}, fetch_list=[y])
+    np.testing.assert_allclose(o2, 2 * o1, rtol=1e-6)
+
+
+def test_program_guard_isolation():
+    paddle.enable_static()
+    main2 = static.Program()
+    with static.program_guard(main2):
+        x = static.data("x", [None, 2])
+        _ = x + 1.0
+    assert len(main2.ops) == 1
+    assert len(static.default_main_program().ops) == 0
+
+
+def test_static_training_converges_like_dygraph():
+    """The headline parity: build net + loss under static mode, SGD
+    minimize, Executor.run loop — and match the dygraph run exactly."""
+    lr, steps = 0.1, 10
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = rng.randn(16, 2).astype(np.float32)
+
+    # dygraph reference
+    paddle.seed(0)
+    dy_net = nn.Linear(4, 2)
+    dy_opt = paddle.optimizer.SGD(learning_rate=lr,
+                                  parameters=dy_net.parameters())
+    dy_losses = []
+    for _ in range(steps):
+        loss = F.mse_loss(dy_net(paddle.to_tensor(xs)),
+                          paddle.to_tensor(ys))
+        loss.backward()
+        dy_opt.step()
+        dy_opt.clear_grad()
+        dy_losses.append(float(loss))
+
+    # static twin
+    paddle.enable_static()
+    paddle.seed(0)
+    st_net = nn.Linear(4, 2)
+    x = static.data("x", [None, 4])
+    y = static.data("y", [None, 2])
+    loss = F.mse_loss(st_net(x), y)
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=st_net.parameters())
+    opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    st_losses = []
+    for _ in range(steps):
+        (l,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        st_losses.append(float(l))
+    paddle.disable_static()
+
+    np.testing.assert_allclose(st_losses, dy_losses, rtol=1e-5,
+                               atol=1e-6)
+    for p_dy, p_st in zip(dy_net.parameters(), st_net.parameters()):
+        np.testing.assert_allclose(np.asarray(p_dy.data),
+                                   np.asarray(p_st.data),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_static_adam_training_decreases_loss():
+    paddle.enable_static()
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    x = static.data("x", [None, 4])
+    y = static.data("y", [None, 1])
+    loss = F.mse_loss(net(x), y)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = rng.randn(32, 1).astype(np.float32)
+    losses = [float(exe.run(feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0])
+              for _ in range(30)]
+    paddle.disable_static()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_clone_for_test_drops_train_hook():
+    paddle.enable_static()
+    net = nn.Linear(2, 1)
+    x = static.data("x", [None, 2])
+    loss = net(x).sum()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    opt.minimize(loss)
+    main = static.default_main_program()
+    assert main._train is not None
+    test_prog = main.clone(for_test=True)
+    assert test_prog._train is None
+    # inference on the clone still works
+    (out,) = static.Executor().run(
+        test_prog, feed={"x": np.ones((2, 2), np.float32)},
+        fetch_list=[loss])
+    assert np.isfinite(out).all()
