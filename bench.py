@@ -145,7 +145,11 @@ def _transient_compile_error(e) -> bool:
     return any(m in s for m in (
         "remote_compile", "HTTP 500", "HTTP 502", "HTTP 503",
         "tpu_compile_helper", "DEADLINE_EXCEEDED", "UNAVAILABLE",
-        "Connection reset", "Connection refused"))
+        "Connection reset", "Connection refused",
+        # remote-backend HBM can be held briefly by an expiring lease
+        # from a killed client; a genuine OOM just costs one bounded
+        # retry
+        "RESOURCE_EXHAUSTED", "ResourceExhausted"))
 
 
 def bench_train_retry(config_name, batch, seq, steps, warmup,
@@ -160,14 +164,23 @@ def bench_train_retry(config_name, batch, seq, steps, warmup,
             return bench_train(config_name, batch, seq, steps, warmup,
                                use_flash=use_flash, remat=remat)
         except Exception as e:
-            if attempt + 1 < tries and _transient_compile_error(e):
-                wait = 20 * (attempt + 1)
-                log(f"  transient compile failure "
-                    f"({type(e).__name__}: {str(e)[:200]}); "
-                    f"retry {attempt + 2}/{tries} in {wait}s")
-                time.sleep(wait)
-                continue
-            raise
+            if not (attempt + 1 < tries and _transient_compile_error(e)):
+                raise
+            msg = f"{type(e).__name__}: {str(e)[:200]}"
+        # the except block has exited: the exception + traceback (which
+        # pin the dead attempt's device arrays) are freed before the
+        # backoff, so HBM is clean for the retry
+        import gc
+        import jax as _jax
+        gc.collect()
+        try:
+            _jax.clear_caches()
+        except Exception:
+            pass
+        wait = 20 * (attempt + 1)
+        log(f"  transient compile failure ({msg}); "
+            f"retry {attempt + 2}/{tries} in {wait}s")
+        time.sleep(wait)
 
 
 def bench_flash(seqs=(1024, 2048, 4096)):
@@ -271,6 +284,21 @@ def main():
         elif r["mfu"] > result["mfu"] and not r["pathological"]:
             result = r
 
+    def release_device_memory():
+        """Failed candidates must not poison later ones: drop compiled
+        executables and force-collect so the dead trainer's params/opt
+        state leave HBM (keeping the raised exception object alive would
+        pin its traceback frames -> the arrays; that leak produced
+        ResourceExhausted on configs that fit fine in a fresh process)."""
+        import gc
+        import jax as _jax
+        gc.collect()
+        try:
+            _jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
+
     sweep_flash = os.environ.get("BENCH_FLASH", "1") != "0"
     for config_name, batch, seq, steps, warmup, remat in sweep:
         try:
@@ -278,9 +306,9 @@ def main():
                                        warmup, use_flash=sweep_flash,
                                        remat=remat, tries=2))
         except Exception as e:  # OOM etc: skip this point
-            last_err = e
-            log(f"  {config_name} b{batch} failed: "
-                f"{type(e).__name__}: {str(e)[:300]}")
+            last_err = f"{type(e).__name__}: {str(e)[:300]}"
+            log(f"  {config_name} b{batch} failed: {last_err}")
+        release_device_memory()
     if result is None or result["pathological"]:
         # flash kernel itself may be the pathology: try composite path
         for config_name, batch, seq, steps, warmup, remat in \
@@ -292,9 +320,10 @@ def main():
                 if result is not None and not result["pathological"]:
                     break
             except Exception as e:
-                last_err = e
+                last_err = f"{type(e).__name__}: {str(e)[:300]}"
                 log(f"  {config_name} b{batch} (no-flash) failed: "
-                    f"{type(e).__name__}: {str(e)[:300]}")
+                    f"{last_err}")
+            release_device_memory()
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_err}")
 
@@ -307,7 +336,7 @@ def main():
                                     result["seq"], max(result["steps"] // 2,
                                                        5), 2,
                                     use_flash=False,
-                                    remat=result["remat"], tries=2)
+                                    remat=result["remat"], tries=3)
             flash_speedup = round(off["step_ms"] / result["step_ms"], 3)
             log(f"  flash A/B: on {result['step_ms']}ms "
                 f"off {off['step_ms']}ms speedup {flash_speedup}x")

@@ -35,14 +35,17 @@ def build_library(output: str | None = None) -> str:
     return out
 
 
-def build_demo(output: str | None = None) -> str:
-    """Compile the standalone C demo executable (capi_demo.c)."""
+def build_demo(output: str | None = None,
+               source: str = "capi_demo.c") -> str:
+    """Compile a standalone C demo executable (capi_demo.c for
+    inference, capi_train_demo.c for the native training entry)."""
     cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
     if cc is None:
         raise RuntimeError("no C compiler found")
-    out = output or os.path.join(HERE, "pd_capi_demo")
+    out = output or os.path.join(
+        HERE, os.path.splitext(source)[0].replace("capi_", "pd_capi_"))
     incs, libs = _embed_flags()
-    cmd = [cc, "-O2", os.path.join(HERE, "capi_demo.c"),
+    cmd = [cc, "-O2", os.path.join(HERE, source),
            os.path.join(HERE, "pd_inference.c"), "-o", out,
            f"-I{HERE}", *incs, *libs]
     subprocess.run(cmd, check=True, capture_output=True, text=True)

@@ -182,3 +182,102 @@ void PD_TensorsFree(PD_Tensor *tensors, int32_t n) {
     for (int i = 0; i < n; i++) free(tensors[i].data);
     free(tensors);
 }
+
+/* ---- training entry ---------------------------------------------------- */
+struct PD_Trainer {
+    long long handle;
+};
+
+PD_Trainer *PD_NewTrainer(const char *model_path) {
+    if (ensure_python() != 0) return NULL;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PD_Trainer *out = NULL;
+    PyObject *m = bridge();
+    if (m) {
+        PyObject *h = PyObject_CallMethod(m, "create_trainer", "s",
+                                          model_path);
+        if (h) {
+            out = (PD_Trainer *)malloc(sizeof(PD_Trainer));
+            out->handle = PyLong_AsLongLong(h);
+            Py_DECREF(h);
+        } else {
+            set_err_from_py("PD_NewTrainer");
+        }
+        Py_DECREF(m);
+    }
+    PyGILState_Release(st);
+    return out;
+}
+
+int PD_TrainerStep(PD_Trainer *trainer,
+                   const PD_Tensor *batch, int32_t n_batch,
+                   float *loss_out) {
+    if (!trainer || ensure_python() != 0) return -1;
+    int rc = -1;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *m = NULL, *args_list = NULL, *res = NULL;
+
+    m = bridge();
+    if (!m) goto done;
+
+    args_list = PyList_New(n_batch);
+    for (int i = 0; i < n_batch; i++) {
+        const PD_Tensor *t = &batch[i];
+        int isz = dtype_size(t->dtype);
+        if (isz < 0 || t->ndim > PD_MAX_DIMS) {
+            snprintf(g_err, sizeof(g_err),
+                     "batch %d: bad dtype %s or ndim %d", i, t->dtype,
+                     t->ndim);
+            goto done;
+        }
+        PyObject *raw = PyBytes_FromStringAndSize(
+            (const char *)t->data, (Py_ssize_t)(numel(t) * isz));
+        PyObject *shape = PyTuple_New(t->ndim);
+        for (int d = 0; d < t->ndim; d++)
+            PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t->shape[d]));
+        PyObject *trip = PyTuple_Pack(3, raw, shape,
+                                      PyUnicode_FromString(t->dtype));
+        Py_DECREF(raw);
+        Py_DECREF(shape);
+        PyList_SET_ITEM(args_list, i, trip);
+    }
+
+    res = PyObject_CallMethod(m, "trainer_step", "LO", trainer->handle,
+                              args_list);
+    if (!res) {
+        set_err_from_py("PD_TrainerStep");
+        goto done;
+    }
+    {
+        PyObject *raw = PyTuple_GetItem(res, 0);
+        float v = 0.0f;
+        memcpy(&v, PyBytes_AsString(raw),
+               sizeof(float) < (size_t)PyBytes_Size(raw)
+                   ? sizeof(float) : (size_t)PyBytes_Size(raw));
+        if (loss_out) *loss_out = v;
+        rc = 0;
+    }
+
+done:
+    Py_XDECREF(res);
+    Py_XDECREF(args_list);
+    Py_XDECREF(m);
+    PyGILState_Release(st);
+    return rc;
+}
+
+void PD_DeleteTrainer(PD_Trainer *trainer) {
+    if (!trainer) return;
+    if (Py_IsInitialized()) {
+        PyGILState_STATE st = PyGILState_Ensure();
+        PyObject *m = bridge();
+        if (m) {
+            PyObject *r = PyObject_CallMethod(m, "destroy_trainer", "L",
+                                              trainer->handle);
+            Py_XDECREF(r);
+            Py_DECREF(m);
+        }
+        PyGILState_Release(st);
+    }
+    free(trainer);
+}

@@ -47,6 +47,19 @@ int PD_PredictorRun(PD_Predictor *pred,
 
 void PD_TensorsFree(PD_Tensor *tensors, int32_t n);
 
+/* Native TRAINING entry (reference fluid/train/demo): loads
+ * "<path>.pdtrain" (serialized StableHLO fwd+bwd+update step from
+ * SpmdTrainer.export_train_step) + "<path>.pdtrainstate".  Each
+ * PD_TrainerStep consumes one (inputs..., labels...) batch and writes
+ * the scalar loss. */
+typedef struct PD_Trainer PD_Trainer;
+
+PD_Trainer *PD_NewTrainer(const char *model_path);
+int PD_TrainerStep(PD_Trainer *trainer,
+                   const PD_Tensor *batch, int32_t n_batch,
+                   float *loss_out);
+void PD_DeleteTrainer(PD_Trainer *trainer);
+
 const char *PD_GetLastError(void);
 
 #ifdef __cplusplus

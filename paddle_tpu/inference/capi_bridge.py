@@ -49,3 +49,59 @@ def run(handle: int,
 
 def destroy(handle: int) -> None:
     _predictors.pop(handle, None)
+
+
+# ---------------------------------------------------------------------------
+# native training entry (reference fluid/train/demo: a C++ program that
+# loads a saved train program and steps it — here the artifact is the
+# serialized StableHLO train step from SpmdTrainer.export_train_step)
+# ---------------------------------------------------------------------------
+_trainers: Dict[int, dict] = {}
+
+
+def create_trainer(path: str) -> int:
+    global _next_handle
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    with open(path + ".pdtrain", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path + ".pdtrainstate", "rb") as f:
+        state = pickle.load(f)
+    h = _next_handle
+    _next_handle += 1
+    _trainers[h] = {
+        "exported": exported,
+        "params": jax.tree_util.tree_map(jnp.asarray, state["params"]),
+        "opt_state": jax.tree_util.tree_map(jnp.asarray,
+                                            state["opt_state"]),
+        "buffers": jax.tree_util.tree_map(jnp.asarray, state["buffers"]),
+        "lr": float(state["lr"]),
+        "step": int(state["step_count"]),
+    }
+    return h
+
+
+def trainer_step(handle: int,
+                 inputs: List[Tuple[bytes, Tuple[int, ...], str]]
+                 ) -> Tuple[bytes, Tuple[int, ...], str]:
+    """Run one serialized train step; returns the loss triple."""
+    import jax.numpy as jnp
+    t = _trainers[handle]
+    batch = [jnp.asarray(np.frombuffer(raw, dtype=np.dtype(dt))
+                         .reshape(tuple(shape)))
+             for raw, shape, dt in inputs]
+    res = t["exported"].call(
+        t["params"], t["opt_state"], t["buffers"],
+        jnp.asarray(t["lr"], jnp.float32),
+        jnp.asarray(t["step"] + 1, jnp.int32), *batch)
+    t["params"], t["opt_state"], t["buffers"], loss = res
+    t["step"] += 1
+    a = np.asarray(loss)
+    return (a.tobytes(), tuple(a.shape), a.dtype.name)
+
+
+def destroy_trainer(handle: int) -> None:
+    _trainers.pop(handle, None)
