@@ -77,9 +77,9 @@ def __getattr__(name):
     if name == "load":
         from .framework.io import load as _load
         return _load
-    if name in ("enable_static", "disable_static", "in_static_mode"):
-        from . import static as _static
-        return getattr(_static, name)
+    if name == "in_static_mode":
+        from .static import in_static_mode
+        return in_static_mode
     if name == "summary":
         from .hapi.model_summary import summary as _summary
         return _summary

@@ -334,5 +334,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return Tensor(arr, stop_gradient=stop_gradient)
 
 
+def unwrap(x):
+    """Tensor -> jax array; anything else through jnp.asarray. The one
+    shared unwrap helper (several op modules used to carry private
+    copies)."""
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
 def is_tensor(x):
     return isinstance(x, Tensor)

@@ -264,6 +264,14 @@ def launch(args=None) -> int:
                 os.environ.get("TMPDIR", "/tmp"),
                 f"paddle_hb_{os.getpid()}_{attempt}")
             os.makedirs(hb_dir, exist_ok=True)
+            # stale beats from a previous attempt/run would trip the
+            # watchdog instantly — each attempt starts with a clean slate
+            for f in os.listdir(hb_dir):
+                if f.startswith("hb."):
+                    try:
+                        os.remove(os.path.join(hb_dir, f))
+                    except OSError:
+                        pass
             os.environ[HEARTBEAT_ENV] = hb_dir  # inherited by children
 
         procs = start_local_trainers(pod, len(endpoints), endpoints,

@@ -54,10 +54,19 @@ class CustomOp:
                     raise ValueError(
                         f"custom op {name!r}: backward returned "
                         f"{len(grads)} grads for {len(args)} inputs")
-                # None -> zero cotangent (non-differentiable input)
+                # None -> zero cotangent; integer/bool primals need the
+                # float0 convention (an int-dtype zeros array would make
+                # jax.vjp reject the rule)
+                import numpy as np
                 import jax.numpy as jnp
+
+                def zero_for(a):
+                    if jnp.issubdtype(jnp.result_type(a), jnp.inexact):
+                        return jnp.zeros_like(a)
+                    return np.zeros(jnp.shape(a), jax.dtypes.float0)
+
                 return tuple(
-                    jnp.zeros_like(a) if g is None else g
+                    zero_for(a) if g is None else g
                     for a, g in zip(args, grads))
 
             fwd.defvjp(_fwd, _bwd)

@@ -131,10 +131,18 @@ int PD_PredictorRun(PD_Predictor *pred,
         PyObject *shape = PyTuple_New(t->ndim);
         for (int d = 0; d < t->ndim; d++)
             PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t->shape[d]));
-        PyObject *trip = PyTuple_Pack(3, raw, shape,
-                                      PyUnicode_FromString(t->dtype));
+        PyObject *dtype = PyUnicode_FromString(t->dtype);
+        if (!raw || !shape || !dtype) {
+            Py_XDECREF(raw);
+            Py_XDECREF(shape);
+            Py_XDECREF(dtype);
+            set_err_from_py("PD_PredictorRun: input marshal");
+            goto done;
+        }
+        PyObject *trip = PyTuple_Pack(3, raw, shape, dtype);
         Py_DECREF(raw);
         Py_DECREF(shape);
+        Py_DECREF(dtype);
         PyList_SET_ITEM(args_list, i, trip); /* steals trip */
     }
 
@@ -235,10 +243,18 @@ int PD_TrainerStep(PD_Trainer *trainer,
         PyObject *shape = PyTuple_New(t->ndim);
         for (int d = 0; d < t->ndim; d++)
             PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t->shape[d]));
-        PyObject *trip = PyTuple_Pack(3, raw, shape,
-                                      PyUnicode_FromString(t->dtype));
+        PyObject *dtype = PyUnicode_FromString(t->dtype);
+        if (!raw || !shape || !dtype) {
+            Py_XDECREF(raw);
+            Py_XDECREF(shape);
+            Py_XDECREF(dtype);
+            set_err_from_py("PD_TrainerStep: input marshal");
+            goto done;
+        }
+        PyObject *trip = PyTuple_Pack(3, raw, shape, dtype);
         Py_DECREF(raw);
         Py_DECREF(shape);
+        Py_DECREF(dtype);
         PyList_SET_ITEM(args_list, i, trip);
     }
 

@@ -201,7 +201,10 @@ class Optimizer:
         from ..static.program import Variable, default_main_program, \
             install_minimize
         if isinstance(loss, Variable):
-            install_minimize(default_main_program(), loss, self)
+            # the loss's OWNING program, not the current default — the
+            # guard that recorded it may have exited already
+            install_minimize(loss.program or default_main_program(),
+                             loss, self)
             return None, []
         self._ensure_fresh_grads(loss)
         self.step()

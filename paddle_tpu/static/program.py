@@ -96,7 +96,7 @@ class Variable:
     _counter = 0
 
     def __init__(self, shape, dtype, name=None, producer=None,
-                 out_index=0):
+                 out_index=0, program=None):
         if name is None:
             Variable._counter += 1
             name = f"_var_{Variable._counter}"
@@ -106,6 +106,10 @@ class Variable:
         self.producer = producer          # _Node or None (graph input)
         self.out_index = out_index
         self.stop_gradient = True
+        # owning program (reference Variable.block.program): minimize()
+        # must land on the program the loss was RECORDED onto, not on
+        # whatever default is active when minimize is called
+        self.program = program
 
     # a minimal operator surface; everything routes through the public
     # ops, which record via apply()
@@ -216,8 +220,9 @@ class Program:
 
 
 def record_data(name, shape, dtype) -> Variable:
-    var = Variable(shape, dtype, name=name)
-    default_main_program()._add_input(var)
+    prog = default_main_program()
+    var = Variable(shape, dtype, name=name, program=prog)
+    prog._add_input(var)
     return var
 
 
@@ -244,11 +249,13 @@ def maybe_record(fn, args, name, amp_cast=None):
     multi = isinstance(out, (tuple, list))
     node.multi = multi
     outs = tuple(out) if multi else (out,)
+    prog = default_main_program()
     out_vars = tuple(
-        Variable(o.shape, o.dtype, producer=node, out_index=i)
+        Variable(o.shape, o.dtype, producer=node, out_index=i,
+                 program=prog)
         for i, o in enumerate(outs))
     node.outputs = list(out_vars)
-    default_main_program()._add_node(node)
+    prog._add_node(node)
     return out_vars if multi else out_vars[0]
 
 
@@ -375,7 +382,11 @@ class Executor:
         trained = [p for p, _ in params if any(id(p) == id(c)
                                                for c in caps)]
 
-        def step(param_arrays, opt_state, frozen_arrays, feed_arrays):
+        def step(param_arrays, opt_state, frozen_arrays, feed_arrays,
+                 lr, step_no):
+            # lr/step_no are ARGUMENTS, not trace-time constants: LR
+            # schedules and Adam bias correction must advance across
+            # exe.run calls without a retrace
             fz = {id(t): a for t, a in zip(frozen, frozen_arrays)}
 
             def loss_of(p_arrays):
@@ -394,8 +405,7 @@ class Executor:
                 optimizer._cur_param = p
                 g = optimizer._apply_decay(param_arrays[i], g, p)
                 np_, ns_ = optimizer._update(
-                    param_arrays[i], g, s, optimizer.get_lr(),
-                    optimizer._step_count + 1)
+                    param_arrays[i], g, s, lr, step_no)
                 new_params.append(np_.astype(param_arrays[i].dtype))
                 new_state.append(ns_)
             return new_params, new_state, fetches
@@ -413,7 +423,9 @@ class Executor:
                 state.append(optimizer._accumulators[key])
             new_params, new_state, fetches = jit_step(
                 [p.data for p in trained], state,
-                [t.data for t in frozen], feed_arrays)
+                [t.data for t in frozen], feed_arrays,
+                jnp.asarray(optimizer.get_lr(), jnp.float32),
+                jnp.asarray(optimizer._step_count + 1, jnp.int32))
             for p, a, s in zip(trained, new_params, new_state):
                 p._data = a
                 optimizer._accumulators[p.name] = s

@@ -21,7 +21,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.autograd import apply
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, unwrap as _arr
 
 __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_reverse",
@@ -33,8 +33,6 @@ __all__ = [
 _NEG = -1e30
 
 
-def _arr(x):
-    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def _mask(lengths, maxlen):

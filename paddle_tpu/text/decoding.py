@@ -22,15 +22,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, unwrap as _arr
 
 __all__ = ["beam_search", "greedy_search", "gather_tree"]
 
 _NEG = -1e9
 
 
-def _arr(x):
-    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def gather_tree(token_ids, parent_ids):

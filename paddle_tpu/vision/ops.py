@@ -21,15 +21,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core.autograd import apply
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, unwrap as _arr
 
 __all__ = ["box_area", "box_iou", "iou_similarity", "box_clip",
            "box_coder", "nms", "multiclass_nms", "prior_box",
            "generate_anchors", "detection_map"]
 
 
-def _arr(x):
-    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def box_area(boxes):

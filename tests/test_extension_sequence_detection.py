@@ -232,3 +232,22 @@ def test_prior_box_and_anchors_shapes():
     # centered on the stride grid
     np.testing.assert_allclose(np.asarray(an.data)[0, 0, 0],
                                [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+
+
+def test_custom_op_none_grad_for_integer_input():
+    """Review fix: a None gradient for an int input must produce the
+    float0 cotangent convention, not int zeros."""
+    def fwd(x, idx):
+        return jnp.take(x, idx, axis=0)
+
+    def bwd(inputs, outputs, cots):
+        x, idx = inputs
+        gx = jnp.zeros_like(x).at[idx].add(cots)
+        return (gx, None)  # index input: non-differentiable
+
+    op = register_op("t_gather_noneg", fwd, backward=bwd)
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32),
+                         stop_gradient=False)
+    idx = paddle.to_tensor(np.array([1, 3], np.int32))
+    op(x, idx).sum().backward()
+    np.testing.assert_array_equal(np.asarray(x.grad.data), [0, 1, 0, 1])

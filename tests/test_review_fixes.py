@@ -109,7 +109,10 @@ def test_amp_casts_inputs_bf16():
     assert captured["dtype"] == jnp.bfloat16
 
 
-def test_fp16_amp_raises():
+def test_fp16_amp_builds_scaled_trainer():
+    """Round 2 asserted fp16 raised; round 5 implemented dynamic loss
+    scaling (tests/test_fp16_scaling.py), so the flag now builds a
+    scaled fp16 trainer instead of failing."""
     paddle.seed(0)
     model = nn.Linear(4, 4)
     opt = paddle.optimizer.SGD(learning_rate=0.1,
@@ -117,9 +120,9 @@ def test_fp16_amp_raises():
     st = DistributedStrategy()
     st.amp = True
     st.amp_configs = {"use_bf16": False}
-    with pytest.raises(NotImplementedError):
-        SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
-                    mesh=create_mesh({"dp": 4}), strategy=st)
+    tr = SpmdTrainer(model, opt, lambda o, l: F.mse_loss(o, l),
+                     mesh=create_mesh({"dp": 4}), strategy=st)
+    assert tr.fp16_scaling and tr.amp_dtype == jnp.float16
 
 
 @pytest.mark.parametrize("flag", ["lars", "lamb", "localsgd", "dgc",

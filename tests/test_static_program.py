@@ -174,3 +174,61 @@ def test_clone_for_test_drops_train_hook():
         test_prog, feed={"x": np.ones((2, 2), np.float32)},
         fetch_list=[loss])
     assert np.isfinite(out).all()
+
+
+def test_minimize_after_guard_exit_lands_on_owning_program():
+    """Review fix: the loss Variable carries its Program, so minimize
+    outside the recording guard still installs the train hook there."""
+    paddle.enable_static()
+    prog = static.Program()
+    with static.program_guard(prog):
+        net = nn.Linear(2, 1)
+        x = static.data("x", [None, 2])
+        loss = net(x).sum()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    opt.minimize(loss)  # guard has exited; default program is different
+    assert prog._train is not None
+    assert static.default_main_program()._train is None
+    w0 = np.asarray(net.weight.data).copy()
+    static.Executor().run(prog, feed={"x": np.ones((2, 2), np.float32)},
+                          fetch_list=[loss])
+    assert not np.allclose(np.asarray(net.weight.data), w0)
+
+
+def test_static_adam_bias_correction_advances():
+    """Review fix: lr/step enter the jitted train step as arguments —
+    static Adam must match dygraph Adam exactly over many steps (a
+    frozen step counter would diverge from step 2 on)."""
+    rng = np.random.RandomState(3)
+    xs = rng.randn(8, 3).astype(np.float32)
+    ys = rng.randn(8, 2).astype(np.float32)
+
+    paddle.seed(0)
+    dy = nn.Linear(3, 2)
+    dopt = paddle.optimizer.Adam(learning_rate=0.05,
+                                 parameters=dy.parameters())
+    dy_losses = []
+    for _ in range(6):
+        loss = F.mse_loss(dy(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        loss.backward()
+        dopt.step()
+        dopt.clear_grad()
+        dy_losses.append(float(loss))
+
+    paddle.enable_static()
+    paddle.seed(0)
+    st = nn.Linear(3, 2)
+    x = static.data("x", [None, 3])
+    y = static.data("y", [None, 2])
+    loss = F.mse_loss(st(x), y)
+    sopt = paddle.optimizer.Adam(learning_rate=0.05,
+                                 parameters=st.parameters())
+    sopt.minimize(loss)
+    exe = static.Executor()
+    st_losses = [float(exe.run(feed={"x": xs, "y": ys},
+                               fetch_list=[loss])[0])
+                 for _ in range(6)]
+    paddle.disable_static()
+    np.testing.assert_allclose(st_losses, dy_losses, rtol=1e-5,
+                               atol=1e-6)
