@@ -1,4 +1,5 @@
 """paddle.metric parity (reference python/paddle/metric/metrics.py:
 Metric base + Accuracy/Precision/Recall/Auc; C++ kernels
 operators/metrics/{accuracy_op,auc_op}.*)."""
-from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy  # noqa: F401
+from .metrics import (  # noqa: F401
+    Metric, Accuracy, Precision, Recall, Auc, accuracy, mean_iou)

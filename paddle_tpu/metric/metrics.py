@@ -5,7 +5,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy",
+           "mean_iou"]
 
 
 def _np(x):
@@ -181,3 +182,23 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         return hit.astype(jnp.float32).mean()
 
     return apply(fn, input, label, name="accuracy")
+
+
+def mean_iou(input, label, num_classes):
+    """Mean intersection-over-union over a segmentation batch
+    (reference mean_iou_op.h / fluid.layers.mean_iou). Returns
+    (mean_iou, per_class_iou, present_mask)."""
+    import numpy as np
+    pred = _np(input).astype(np.int64).reshape(-1)
+    gt = _np(label).astype(np.int64).reshape(-1)
+    ious, present = [], []
+    for c in range(num_classes):
+        p = pred == c
+        g = gt == c
+        union = (p | g).sum()
+        present.append(bool(g.any() or p.any()))
+        ious.append(float((p & g).sum() / union) if union else 0.0)
+    ious = np.asarray(ious, np.float32)
+    present = np.asarray(present)
+    miou = float(ious[present].mean()) if present.any() else 0.0
+    return miou, ious, present

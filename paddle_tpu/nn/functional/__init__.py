@@ -6,5 +6,6 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention)

@@ -20,7 +20,8 @@ __all__ = [
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "ctc_loss", "log_loss", "square_error_cost",
-    "sigmoid_focal_loss", "triplet_margin_loss",
+    "sigmoid_focal_loss", "triplet_margin_loss", "dice_loss",
+    "npair_loss",
 ]
 
 
@@ -315,3 +316,34 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(fn, log_probs, labels, input_lengths, label_lengths,
                  name="ctc_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference fluid/layers/nn.py dice_loss: 1 - 2|X∩Y| / (|X|+|Y|)
+    over the per-example flattened probabilities."""
+    def fn(x, y):
+        y = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32),
+                           x.shape[-1], dtype=x.dtype) \
+            if y.shape != x.shape else y.astype(x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, input, label, name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference fluid/layers/loss.py npair_loss (Sohn'16): softmax
+    cross-entropy over anchor·positiveᵀ similarities + L2 on embeddings."""
+    def fn(a, p, lab):
+        sim = a @ p.T                                       # [B, B]
+        same = (lab.reshape(-1, 1) == lab.reshape(1, -1)).astype(a.dtype)
+        tgt = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -(tgt * logp).sum(axis=1).mean()
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / \
+            (2.0 * a.shape[0])
+        return ce + reg
+
+    return apply(fn, anchor, positive, labels, name="npair_loss")

@@ -1,0 +1,103 @@
+"""Vision sampling/warping functionals.
+
+Reference: paddle/fluid/operators/grid_sampler_op.h (bilinear grid
+sampling with zero padding), affine_grid_op.h (theta -> sampling grid),
+temporal_shift_op.h (TSM channel shifting).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+
+__all__ = ["grid_sample", "affine_grid", "temporal_shift"]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear sampling of x [N, C, H, W] at grid [N, Hg, Wg, 2]
+    (normalized coords in [-1, 1], (x, y) order — grid_sampler_op.h)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear/nearest, "
+                         f"got {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def fn(xa, ga):
+        n, c, h, w = xa.shape
+        gx, gy = ga[..., 0], ga[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        bidx = jnp.arange(n)[:, None, None]
+
+        def take(ix, iy):
+            inside = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            v = xa[bidx, :, iyc, ixc]                # [N, Hg, Wg, C]
+            if padding_mode == "zeros":
+                v = jnp.where(inside[..., None], v, 0.0)
+            return v
+
+        if mode == "nearest":
+            out = take(jnp.round(fx).astype(jnp.int32),
+                       jnp.round(fy).astype(jnp.int32))
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(xa.dtype)[..., None]
+        wy = (fy - y0).astype(xa.dtype)[..., None]
+        out = (take(x0, y0) * (1 - wx) * (1 - wy) +
+               take(x1, y0) * wx * (1 - wy) +
+               take(x0, y1) * (1 - wx) * wy +
+               take(x1, y1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)              # [N, C, Hg, Wg]
+
+    return apply(fn, x, grid, name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (affine_grid_op)."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gx, gy = jnp.meshgrid(xs, ys)                # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+
+    return apply(fn, theta, name="affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """TSM shift (temporal_shift_op.h): x [N*T, C, H, W]; the first
+    shift_ratio channels shift -1 in time, the next shift_ratio shift
+    +1, the rest stay."""
+    def fn(xa):
+        nt, c, h, w = xa.shape
+        t = seg_num
+        n = nt // t
+        v = xa.reshape(n, t, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.zeros_like(v[:, :1])
+        fwd = jnp.concatenate([v[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+        back = jnp.concatenate([pad[:, :, c1:c2], v[:, :-1, c1:c2]],
+                               axis=1)
+        keep = v[:, :, c2:]
+        return jnp.concatenate([fwd, back, keep],
+                               axis=2).reshape(nt, c, h, w)
+
+    return apply(fn, x, name="temporal_shift")

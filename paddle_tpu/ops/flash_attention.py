@@ -248,7 +248,7 @@ def _pick_block(s, want=256):
     return want
 
 
-def _fwd_gqa(q4, k3, v3, mask, causal, block_q=256, block_k=256):
+def _fwd_gqa(q4, k3, v3, mask, causal, block_q=512, block_k=512):
     bhkv, g, s, d = q4.shape
     hkv = bhkv // mask.shape[0]
     block_q = _pick_block(s, block_q)
@@ -283,7 +283,7 @@ def _fwd_gqa(q4, k3, v3, mask, causal, block_q=256, block_k=256):
 
 
 def _bwd_gqa(q4, k3, v3, mask, o4, lse, do4, causal,
-             block_q=256, block_k=256):
+             block_q=512, block_k=512):
     bhkv, g, s, d = q4.shape
     hkv = bhkv // mask.shape[0]
     block_q = _pick_block(s, block_q)

@@ -478,3 +478,30 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [apply(jnp.atleast_3d, _t(x), name="atleast_3d") for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop a static window (reference crop_tensor_op): take
+    x[offsets[i] : offsets[i] + shape[i]] along every dim. shape entries
+    of -1 keep everything from the offset on."""
+    xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    nd = xa.ndim
+    offs = [int(o) for o in (offsets if offsets is not None
+                             else [0] * nd)]
+    shp = [int(s) for s in (shape if shape is not None
+                            else list(xa.shape))]
+    if len(offs) != nd or len(shp) != nd:
+        raise ValueError(f"crop: offsets/shape must have {nd} entries")
+    sizes = [xa.shape[i] - offs[i] if shp[i] == -1 else shp[i]
+             for i in range(nd)]
+    for i in range(nd):
+        if offs[i] + sizes[i] > xa.shape[i]:
+            raise ValueError(
+                f"crop window exceeds dim {i}: {offs[i]}+{sizes[i]} > "
+                f"{xa.shape[i]}")
+
+    def fn(a):
+        return jax.lax.slice(a, offs,
+                             [o + s for o, s in zip(offs, sizes)])
+
+    return apply(fn, x, name="crop")

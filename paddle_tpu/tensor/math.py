@@ -441,3 +441,15 @@ def bincount(x, weights=None, minlength=0, name=None):
     x = _t(x)
     w = weights.data if isinstance(weights, Tensor) else weights
     return Tensor(jnp.bincount(x.data, weights=w, minlength=minlength))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x down so its L2 norm is at most max_norm (reference
+    clip_by_norm_op.h)."""
+    def fn(a):
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(a.astype(jnp.float32) ** 2),
+                                    1e-12))
+        scale = jnp.minimum(max_norm / norm, 1.0).astype(a.dtype)
+        return a * scale
+
+    return apply(fn, x, name="clip_by_norm")

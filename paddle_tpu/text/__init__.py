@@ -13,11 +13,13 @@ from .utils import (  # noqa: F401
     causal_mask, padding_attn_mask)
 from .datasets import (  # noqa: F401
     UCIHousing, Imdb, Imikolov, Movielens, WMT14, Conll05st, WMT16)
-from .decoding import beam_search, greedy_search, gather_tree  # noqa: F401
+from .decoding import (  # noqa: F401
+    beam_search, greedy_search, gather_tree, viterbi_decode)
 
 __all__ = [
     "sequence_mask", "pad_sequences", "truncate_sequences",
     "shift_tokens_right", "causal_mask", "padding_attn_mask",
     "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14", "WMT16",
     "Conll05st", "beam_search", "greedy_search", "gather_tree",
+    "viterbi_decode",
 ]

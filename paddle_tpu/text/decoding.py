@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, unwrap as _arr
 
-__all__ = ["beam_search", "greedy_search", "gather_tree"]
+__all__ = ["beam_search", "greedy_search", "gather_tree",
+           "viterbi_decode"]
 
 _NEG = -1e9
 
@@ -135,3 +136,63 @@ def greedy_search(step_fn: Callable, init_state, batch_size: int,
     _, toks = jax.lax.scan(step, (tokens0, fin0, init_state), None,
                            length=max_len)
     return Tensor(jnp.moveaxis(toks, 0, 1))
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag=False):
+    """CRF Viterbi decode (reference crf_decoding_op.h /
+    paddle.text.viterbi_decode): emission potentials [B, T, N] +
+    transition [N, N] -> (scores [B], best paths [B, T]).  One lax.scan
+    forward pass keeping per-tag backpointers, one reverse scan to read
+    the argmax path; rows past `lengths` freeze (mask convention).
+    include_bos_eos_tag treats the last two tags as BOS/EOS like the
+    reference (start/stop transition rows added at the boundaries)."""
+    em = _arr(potentials).astype(jnp.float32)       # [B, T, N]
+    tr = _arr(transition).astype(jnp.float32)       # [N, N]
+    b, t, n = em.shape
+    if lengths is None:
+        ln = jnp.full((b,), t, jnp.int32)
+    else:
+        ln = _arr(lengths).astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 = BOS, N-1 = EOS
+        start = tr[n - 2]                           # [N]
+        stop = tr[:, n - 1]                         # [N]
+    else:
+        start = jnp.zeros((n,), jnp.float32)
+        stop = jnp.zeros((n,), jnp.float32)
+
+    alpha0 = em[:, 0] + start[None, :]              # [B, N]
+
+    def fwd(carry, i):
+        alpha = carry                               # [B, N]
+        # score of arriving at tag j from tag k
+        cand = alpha[:, :, None] + tr[None, :, :]   # [B, from, to]
+        best = cand.max(axis=1) + em[:, i]          # [B, N]
+        bp = cand.argmax(axis=1).astype(jnp.int32)  # [B, N]
+        keep = (i < ln)[:, None]
+        alpha = jnp.where(keep, best, alpha)
+        return alpha, bp
+
+    alpha, bps = jax.lax.scan(fwd, alpha0, jnp.arange(1, t))
+    # EOS transition applies at each row's LAST valid position
+    final = alpha + stop[None, :]
+    scores = final.max(axis=1)
+    last_tag = final.argmax(axis=1).astype(jnp.int32)   # [B]
+
+    def back(carry, i):
+        tag = carry                                  # [B]
+        # bps[i] maps position i+1's tag -> best previous tag
+        prev = jnp.take_along_axis(bps[i], tag[:, None],
+                                   axis=1)[:, 0]
+        # positions at/after the row's end keep the frozen tag
+        tag_new = jnp.where(i + 1 < ln, prev, tag)
+        return tag_new, tag
+
+    tag_final, tags_rev = jax.lax.scan(
+        back, last_tag, jnp.arange(t - 2, -1, -1))
+    path = jnp.concatenate(
+        [tag_final[:, None],
+         jnp.moveaxis(tags_rev[::-1], 0, 1)], axis=1)   # [B, T]
+    return Tensor(scores), Tensor(path)

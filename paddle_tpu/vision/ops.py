@@ -25,7 +25,7 @@ from ..core.tensor import Tensor, unwrap as _arr
 
 __all__ = ["box_area", "box_iou", "iou_similarity", "box_clip",
            "box_coder", "nms", "multiclass_nms", "prior_box",
-           "generate_anchors", "detection_map"]
+           "generate_anchors", "detection_map", "roi_align", "roi_pool"]
 
 
 
@@ -202,6 +202,96 @@ def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
     if clip:
         out = np.clip(out, 0.0, 1.0)
     return Tensor(jnp.asarray(out.astype(np.float32)))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7,
+              spatial_scale=1.0, sampling_ratio=2, aligned=True):
+    """RoIAlign (roi_align_op.h): bilinear-sample each RoI into a fixed
+    [C, P, P] grid.  x: [N, C, H, W]; boxes: [R, 4] in image coords with
+    boxes_num [N] mapping rows to batch images ([R] rois assumed all on
+    image 0 when boxes_num is None)."""
+    from ..nn.functional.vision import grid_sample
+
+    ps = (output_size if isinstance(output_size, (tuple, list))
+          else (output_size, output_size))
+    ph, pw = int(ps[0]), int(ps[1])
+    xa = _arr(x)
+    ba = _arr(boxes).astype(jnp.float32)
+    n, c, h, w = xa.shape
+    r = ba.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = jnp.asarray(_arr(boxes_num), jnp.int32)
+        img_of = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bn,
+                            total_repeat_length=r)
+
+    off = 0.5 if aligned else 0.0
+    x1 = ba[:, 0] * spatial_scale - off
+    y1 = ba[:, 1] * spatial_scale - off
+    x2 = ba[:, 2] * spatial_scale - off
+    y2 = ba[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-3)
+    rh = jnp.maximum(y2 - y1, 1e-3)
+    sr = max(int(sampling_ratio), 1)
+
+    # sample centers: for bin (i, j), sr x sr points
+    ys = (jnp.arange(ph * sr) + 0.5) / sr          # in bin units
+    xs = (jnp.arange(pw * sr) + 0.5) / sr
+    gy = y1[:, None] + rh[:, None] * ys[None, :] / ph       # [R, ph*sr]
+    gx = x1[:, None] + rw[:, None] * xs[None, :] / pw       # [R, pw*sr]
+    # normalized [-1, 1] for grid_sample (align_corners=True)
+    ngy = gy / jnp.maximum(h - 1, 1) * 2 - 1
+    ngx = gx / jnp.maximum(w - 1, 1) * 2 - 1
+    grid = jnp.stack(
+        [jnp.broadcast_to(ngx[:, None, :], (r, ph * sr, pw * sr)),
+         jnp.broadcast_to(ngy[:, :, None], (r, ph * sr, pw * sr))],
+        axis=-1)                                    # [R, phs, pws, 2]
+    per_roi_x = xa[img_of]                          # [R, C, H, W]
+    sampled = grid_sample(Tensor(per_roi_x), Tensor(grid),
+                          align_corners=True)
+    sa = _arr(sampled).reshape(r, c, ph, sr, pw, sr)
+    return Tensor(sa.mean(axis=(3, 5)))             # avg over samples
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """RoIPool (roi_pool_op.h): max over each bin.  Implemented as
+    dense RoIAlign sampling followed by max (XLA-friendly fixed shapes;
+    exact argmax-bin parity is not preserved for degenerate rois)."""
+    from ..nn.functional.vision import grid_sample
+
+    ps = (output_size if isinstance(output_size, (tuple, list))
+          else (output_size, output_size))
+    ph, pw = int(ps[0]), int(ps[1])
+    xa = _arr(x)
+    ba = _arr(boxes).astype(jnp.float32)
+    n, c, h, w = xa.shape
+    r = ba.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = jnp.asarray(_arr(boxes_num), jnp.int32)
+        img_of = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bn,
+                            total_repeat_length=r)
+    sr = 2
+    x1 = ba[:, 0] * spatial_scale
+    y1 = ba[:, 1] * spatial_scale
+    rw = jnp.maximum(ba[:, 2] * spatial_scale - x1, 1e-3)
+    rh = jnp.maximum(ba[:, 3] * spatial_scale - y1, 1e-3)
+    ys = (jnp.arange(ph * sr) + 0.5) / sr
+    xs = (jnp.arange(pw * sr) + 0.5) / sr
+    gy = y1[:, None] + rh[:, None] * ys[None, :] / ph
+    gx = x1[:, None] + rw[:, None] * xs[None, :] / pw
+    ngy = gy / jnp.maximum(h - 1, 1) * 2 - 1
+    ngx = gx / jnp.maximum(w - 1, 1) * 2 - 1
+    grid = jnp.stack(
+        [jnp.broadcast_to(ngx[:, None, :], (r, ph * sr, pw * sr)),
+         jnp.broadcast_to(ngy[:, :, None], (r, ph * sr, pw * sr))],
+        axis=-1)
+    sampled = grid_sample(Tensor(xa[img_of]), Tensor(grid),
+                          align_corners=True)
+    sa = _arr(sampled).reshape(r, c, ph, sr, pw, sr)
+    return Tensor(sa.max(axis=(3, 5)))
 
 
 def detection_map(detections, gt_boxes, gt_labels,

@@ -1,0 +1,174 @@
+"""grid_sample/affine_grid/temporal_shift, RoI ops, new losses,
+clip_by_norm, crop, mean_iou, viterbi_decode.
+
+References: grid_sampler_op.h, affine_grid_op.h, temporal_shift_op.h,
+roi_align_op.h, fluid dice_loss/npair_loss, clip_by_norm_op.h,
+crop_tensor_op, mean_iou_op.h, crf_decoding_op.h.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.metric import mean_iou
+from paddle_tpu.text import viterbi_decode
+from paddle_tpu.vision import ops as V
+
+
+def test_affine_grid_identity_and_grid_sample_roundtrip():
+    n, c, h, w = 2, 3, 5, 7
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(n, c, h, w).astype(np.float32))
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32),
+                    (n, 1, 1))
+    grid = F.affine_grid(paddle.to_tensor(theta), (n, c, h, w))
+    out = F.grid_sample(x, grid)
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(x.data),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grid_sample_flip_and_zero_padding():
+    x = paddle.to_tensor(
+        np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    # horizontal flip
+    theta = np.array([[[-1, 0, 0], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(paddle.to_tensor(theta), (1, 1, 2, 2))
+    out = np.asarray(F.grid_sample(x, grid).data)
+    np.testing.assert_allclose(out[0, 0], [[1, 0], [3, 2]], atol=1e-5)
+    # sampling fully outside -> zeros
+    far = np.full((1, 2, 2, 2), 5.0, np.float32)
+    out2 = np.asarray(F.grid_sample(x, paddle.to_tensor(far)).data)
+    np.testing.assert_allclose(out2, 0.0)
+
+
+def test_grid_sample_differentiable():
+    x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32),
+                         stop_gradient=False)
+    grid = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+    F.grid_sample(x, grid).sum().backward()
+    assert float(np.asarray(x.grad.data).sum()) == pytest.approx(4.0)
+
+
+def test_temporal_shift_moves_channels():
+    nt, c, h, w = 4, 4, 1, 1   # n=2 videos of t=2
+    x = np.arange(nt * c, dtype=np.float32).reshape(nt, c, h, w)
+    out = np.asarray(F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                      shift_ratio=0.25).data)
+    v = x.reshape(2, 2, c)
+    # channel 0 shifts from t+1; channel 1 from t-1; channels 2-3 stay
+    assert out[0, 0, 0, 0] == v[0, 1, 0]       # t=0 takes t=1
+    assert out[1, 0, 0, 0] == 0.0              # t=1 takes padding
+    assert out[0, 1, 0, 0] == 0.0              # t=0 takes padding
+    assert out[1, 1, 0, 0] == v[0, 0, 1]       # t=1 takes t=0
+    np.testing.assert_array_equal(out[:, 2:, 0, 0], x[:, 2:, 0, 0])
+
+
+def test_roi_align_constant_map():
+    """On a constant feature map every RoI bin must equal the constant."""
+    x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.5, np.float32))
+    boxes = paddle.to_tensor(
+        np.array([[0, 0, 8, 8], [4, 4, 12, 15]], np.float32))
+    out = np.asarray(V.roi_align(x, boxes, output_size=4).data)
+    assert out.shape == (2, 2, 4, 4)
+    np.testing.assert_allclose(out, 3.5, atol=1e-5)
+
+
+def test_roi_align_gradient_ramp():
+    """On a horizontal ramp, bin means must increase left to right."""
+    ramp = np.tile(np.arange(16, dtype=np.float32), (16, 1))
+    x = paddle.to_tensor(ramp.reshape(1, 1, 16, 16))
+    boxes = paddle.to_tensor(np.array([[0, 0, 15, 15]], np.float32))
+    out = np.asarray(V.roi_align(x, boxes, output_size=4).data)[0, 0]
+    for j in range(3):
+        assert (out[:, j] < out[:, j + 1]).all()
+
+
+def test_roi_pool_takes_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 1, 1] = 9.0
+    out = np.asarray(V.roi_pool(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32)),
+        output_size=2).data)
+    # sampled max (bilinear grid) peaks NEAR the spike, exact argmax-bin
+    # parity is documented as not preserved
+    assert out[0, 0, 0, 0] > 5.0
+    assert out[0, 0, 1, 1] == pytest.approx(0.0, abs=1e-4)
+    assert out[0, 0, 0, 0] == out.max()
+
+
+def test_dice_and_npair_losses():
+    probs = paddle.to_tensor(
+        np.array([[[0.9, 0.1], [0.2, 0.8]]], np.float32))
+    labels = paddle.to_tensor(np.array([[[0], [1]]], np.int64))
+    d = float(F.dice_loss(probs, labels))
+    assert 0.0 < d < 0.2  # near-perfect prediction -> small loss
+
+    rng = np.random.RandomState(0)
+    a = paddle.to_tensor(rng.randn(4, 8).astype(np.float32),
+                         stop_gradient=False)
+    p = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    lab = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    loss = F.npair_loss(a, p, lab)
+    loss.backward()
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(a.grad.data)).all()
+
+
+def test_clip_by_norm():
+    x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    out = np.asarray(paddle.clip_by_norm(x, 1.0).data)
+    np.testing.assert_allclose(out, [0.6, 0.8], rtol=1e-5)
+    # under the cap: unchanged
+    np.testing.assert_allclose(
+        np.asarray(paddle.clip_by_norm(x, 100.0).data), [3.0, 4.0])
+
+
+def test_crop():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+    out = np.asarray(paddle.crop(x, shape=[2, 3], offsets=[1, 2]).data)
+    np.testing.assert_array_equal(out, np.asarray(x.data)[1:3, 2:5])
+    out2 = np.asarray(paddle.crop(x, shape=[-1, 2], offsets=[2, 0]).data)
+    np.testing.assert_array_equal(out2, np.asarray(x.data)[2:, :2])
+    with pytest.raises(ValueError):
+        paddle.crop(x, shape=[9, 9], offsets=[0, 0])
+
+
+def test_mean_iou():
+    pred = np.array([[0, 0, 1, 1]], np.int64)
+    gt = np.array([[0, 1, 1, 1]], np.int64)
+    miou, ious, present = mean_iou(pred, gt, num_classes=3)
+    # class 0: inter 1, union 2 -> .5; class 1: inter 2, union 3 -> 2/3
+    assert ious[0] == pytest.approx(0.5)
+    assert ious[1] == pytest.approx(2 / 3)
+    assert not present[2]
+    assert miou == pytest.approx((0.5 + 2 / 3) / 2)
+
+
+def brute_viterbi(em, tr, length):
+    best, path = -np.inf, None
+    t, n = em.shape
+    for seq in itertools.product(range(n), repeat=length):
+        s = em[0, seq[0]]
+        for i in range(1, length):
+            s += tr[seq[i - 1], seq[i]] + em[i, seq[i]]
+        if s > best:
+            best, path = s, seq
+    return best, path
+
+
+def test_viterbi_decode_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, n = 3, 5, 4
+    em = rng.randn(b, t, n).astype(np.float32)
+    tr = rng.randn(n, n).astype(np.float32)
+    lengths = np.array([5, 3, 4], np.int64)
+    scores, paths = viterbi_decode(em, tr, lengths)
+    for i in range(b):
+        want_s, want_p = brute_viterbi(em[i], tr, int(lengths[i]))
+        assert float(np.asarray(scores.data)[i]) == \
+            pytest.approx(want_s, rel=1e-4), f"row {i}"
+        got = tuple(np.asarray(paths.data)[i][:int(lengths[i])].tolist())
+        assert got == want_p, f"row {i}: {got} vs {want_p}"
