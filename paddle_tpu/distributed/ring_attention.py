@@ -65,16 +65,19 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
     g = h // hkv                               # q heads per kv head
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     # [B,T,H,D] -> [B,Hkv,G,T,D]; kv head j serves q heads [j*g,(j+1)*g)
-    qf = jnp.swapaxes(q.astype(jnp.float32), 1, 2) \
-        .reshape(b, hkv, g, t, d) * sc
+    # inputs stay in their storage dtype (bf16) for the MXU einsums —
+    # f32 matmul inputs run at a fraction of the bf16 rate; softmax
+    # statistics accumulate in f32 via preferred_element_type
+    qf = jnp.swapaxes(q, 1, 2).reshape(b, hkv, g, t, d)
     q_pos = rank * t + jnp.arange(t)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
     def block(o, m, l, k_cur, v_cur, i):
         src = (rank - i) % sp                  # origin block of k_cur
-        kf = jnp.swapaxes(k_cur.astype(jnp.float32), 1, 2)  # [B,Hkv,T,D]
-        vf = jnp.swapaxes(v_cur.astype(jnp.float32), 1, 2)
-        s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf)
+        kf = jnp.swapaxes(k_cur, 1, 2)                      # [B,Hkv,T,D]
+        vf = jnp.swapaxes(v_cur, 1, 2)
+        s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, kf,
+                       preferred_element_type=jnp.float32) * sc
         if causal:
             k_pos = src * t + jnp.arange(t)
             mask = q_pos[:, None] >= k_pos[None, :]        # [T,T]
@@ -86,7 +89,9 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
             p = p * mask[None, None, None]
         alpha = jnp.exp(m - m_new)
         l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhgqk,bhkd->bhgqd", p, vf)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhgqk,bhkd->bhgqd", p.astype(vf.dtype), vf,
+            preferred_element_type=jnp.float32)
         return o, m_new, l
 
     def round_(carry, i):

@@ -74,7 +74,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
     s = k_ref.shape[0]
     n_k = s // block_k
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    # keep q/k/v in their storage dtype (bf16) for the MXU dots — f32
+    # matmul inputs run at a fraction of the bf16 MXU rate; accumulation
+    # stays f32 via preferred_element_type (the standard mixed scheme)
+    q = q_ref[:]
     qi = pl.program_id(2)
     q_start = qi * block_q
 
@@ -84,11 +87,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         sblk = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bq, bk]
+            preferred_element_type=jnp.float32) * scale    # [bq, bk] f32
         # reshape the f32 mask BEFORE comparing: mosaic can't insert a
         # minor dim on 1-bit vectors
         kv_f = m_ref[0, pl.ds(j * block_k, block_k)]       # (bk,) f32
@@ -105,7 +108,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -133,17 +136,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, m_ref,
     qi = pl.program_id(2)
     q_start = qi * block_q
 
-    qs = q_ref[:].astype(jnp.float32) * scale              # [bq, d]
-    do = do_ref[:].astype(jnp.float32)                     # [bq, d]
+    # bf16 MXU inputs, f32 accumulation (see _fwd_kernel note)
+    qs = q_ref[:]                                          # [bq, d]
+    do = do_ref[:]                                         # [bq, d]
     lse = lse_ref[0, :]                                    # (bq,)
     delta = dl_ref[0, :]                                   # (bq,)
 
     def body(j, dq_acc):
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         st = jax.lax.dot_general(
             k_blk, qs, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bk, bq]
+            preferred_element_type=jnp.float32) * scale    # [bk, bq]
         kv_f = m_ref[0, pl.ds(j * block_k, block_k)]       # (bk,) f32
         st = jnp.where(kv_f[:, None] > 0, st, _NEG)
         if causal:
@@ -157,7 +161,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, m_ref,
         dpT = jax.lax.dot_general(
             v_blk, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, bq]
-        dsT = pT * (dpT - delta[None, :])
+        dsT = (pT * (dpT - delta[None, :])).astype(k_blk.dtype)
         return dq_acc + jax.lax.dot_general(
             dsT, k_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, d]
@@ -185,21 +189,20 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref, m_ref,
     g = pl.program_id(2)
     k_start = kj * block_k
 
-    k_blk = k_ref[:].astype(jnp.float32)
-    v_blk = v_ref[:].astype(jnp.float32)
+    # bf16 MXU inputs, f32 accumulation (see _fwd_kernel note)
+    k_blk = k_ref[:]
+    v_blk = v_ref[:]
     kv_f = m_ref[0, pl.ds(k_start, block_k)]               # (bk,) f32
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[pl.ds(i * block_q, block_q), :] \
-            .astype(jnp.float32) * scale                   # [bq, d]
-        do_blk = do_ref[pl.ds(i * block_q, block_q), :] \
-            .astype(jnp.float32)
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :]      # [bq, d]
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(i * block_q, block_q)]      # (bq,)
         delta = dl_ref[0, pl.ds(i * block_q, block_q)]
         st = jax.lax.dot_general(
             k_blk, q_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bk, bq]
+            preferred_element_type=jnp.float32) * scale    # [bk, bq]
         st = jnp.where(kv_f[:, None] > 0, st, _NEG)
         if causal:
             krows = k_start + jax.lax.broadcasted_iota(
@@ -209,13 +212,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref, m_ref,
             st = jnp.where(qcols >= krows, st, _NEG)
         pT = jnp.exp(st - lse[None, :])
         pT = jnp.where(st <= _NEG / 2, 0.0, pT)
+        pT16 = pT.astype(do_blk.dtype)
         dv_acc = dv_acc + jax.lax.dot_general(
-            pT, do_blk, (((1,), (0,)), ((), ())),
+            pT16, do_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, d]
         dpT = jax.lax.dot_general(
             v_blk, do_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, bq]
-        dsT = pT * (dpT - delta[None, :])
+        dsT = (pT * (dpT - delta[None, :])).astype(q_blk.dtype)
         dk_acc = dk_acc + jax.lax.dot_general(
             dsT, q_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, d]
@@ -225,6 +229,9 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dl_ref, m_ref,
     dk, dv = jax.lax.fori_loop(
         i0, n_q, body, (jnp.zeros((block_k, d), jnp.float32),
                         jnp.zeros((block_k, d), jnp.float32)))
+    # dk_j = scale * Σ ds_ij q_i (scale was folded into q before the
+    # bf16-input rework; now applied once here)
+    dk = dk * scale
 
     @pl.when(g == 0)
     def _init():
