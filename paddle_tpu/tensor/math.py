@@ -453,3 +453,58 @@ def clip_by_norm(x, max_norm, name=None):
         return a * scale
 
     return apply(fn, x, name="clip_by_norm")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Numerically-stable cumulative logsumexp (reference
+    logcumsumexp_op): running max + rescaled running sum along `axis`
+    (flattened when axis is None), as one lax.scan."""
+    def fn(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis % arr.ndim
+        m = jnp.moveaxis(arr.astype(jnp.float32), ax, 0)
+
+        def step(carry, v):
+            run_max, run_sum = carry
+            new_max = jnp.maximum(run_max, v)
+            run_sum = run_sum * jnp.exp(run_max - new_max) + \
+                jnp.exp(v - new_max)
+            return (new_max, run_sum), new_max + jnp.log(run_sum)
+
+        init = (jnp.full(m.shape[1:], -jnp.inf, jnp.float32),
+                jnp.zeros(m.shape[1:], jnp.float32))
+        _, out = jax.lax.scan(step, init, m)
+        out = jnp.moveaxis(out, 0, ax)
+        return out.astype(dtype or a.dtype) if jnp.issubdtype(
+            a.dtype, jnp.floating) else out
+
+    return apply(fn, x, name="logcumsumexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (reference trapezoid op /
+    paddle.trapezoid)."""
+    if x is not None:
+        def fn(ya, xa):
+            return jnp.trapezoid(ya, xa, axis=axis)
+        return apply(fn, y, x, name="trapezoid")
+
+    step = 1.0 if dx is None else float(dx)
+
+    def fn(ya):
+        return jnp.trapezoid(ya, dx=step, axis=axis)
+    return apply(fn, y, name="trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference renorm_op): every
+    slice whose norm exceeds max_norm is scaled down to it."""
+    def fn(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a.astype(jnp.float32)) ** p,
+                        axis=dims, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (a * scale.astype(a.dtype))
+
+    return apply(fn, x, name="renorm")

@@ -121,3 +121,31 @@ class TestReviewRegressionsRound1b:
         import paddle_tpu as paddle
         d = {paddle.CPUPlace(): 1, paddle.TPUPlace(0): 2}
         assert d[paddle.CPUPlace()] == 1
+
+
+def test_logcumsumexp_trapezoid_renorm():
+    """Round-5 math stragglers (logcumsumexp_op, trapezoid, renorm_op)."""
+    import numpy as np
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    out = paddle.logcumsumexp(x)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.log(np.cumsum(np.exp([1, 2, 3]))),
+                               rtol=1e-5)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad.data)).all()
+
+    y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    assert float(paddle.trapezoid(y)) == 4.0
+    xs = paddle.to_tensor(np.array([0.0, 2.0, 4.0], np.float32))
+    assert float(paddle.trapezoid(y, x=xs)) == 8.0
+
+    m = paddle.to_tensor(np.eye(2, dtype=np.float32) * 3)
+    r = np.asarray(paddle.renorm(m, 2.0, 0, 1.0).data)
+    np.testing.assert_allclose(np.linalg.norm(r, axis=1), [1.0, 1.0],
+                               rtol=1e-5)
+    # slices under the cap are untouched
+    small = paddle.to_tensor(np.eye(2, dtype=np.float32) * 0.5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.renorm(small, 2.0, 0, 1.0).data),
+        np.asarray(small.data))
