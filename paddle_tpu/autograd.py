@@ -15,4 +15,110 @@ from .core.autograd import (  # noqa: F401
 )
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad",
-           "set_grad_enabled", "is_grad_enabled"]
+           "set_grad_enabled", "is_grad_enabled", "PyLayer",
+           "PyLayerContext"]
+
+
+class PyLayerContext:
+    """Context passed through PyLayer.forward/backward (reference
+    python/paddle/autograd PyLayerContext): carries saved tensors and
+    arbitrary user attributes between the passes."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined eager op with a custom backward (reference
+    paddle.autograd.PyLayer):
+
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor()
+                return dy * y
+
+    forward runs under no_grad (the custom backward REPLACES autodiff
+    for this region, like the reference's PyLayer op); backward receives
+    one cotangent per forward output and returns one gradient (or None)
+    per differentiable forward input.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core.autograd import GradNode, _grad_enabled, no_grad
+        from .core.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        out_arrs = tuple(o.data if isinstance(o, Tensor) else o
+                         for o in outs)
+
+        tensor_inputs = [a if isinstance(a, Tensor) else None
+                         for a in args]
+        needs = _grad_enabled() and any(
+            t is not None and not t.stop_gradient for t in tensor_inputs)
+        if not needs:
+            wrapped = tuple(Tensor(a, stop_gradient=True)
+                            for a in out_arrs)
+            return wrapped if multi else wrapped[0]
+
+        def vjp_fn(cots):
+            cot_arrs = cots if isinstance(cots, tuple) else (cots,)
+            cot_ts = tuple(Tensor(c, stop_gradient=True)
+                           for c in cot_arrs)
+            with no_grad():
+                gs = cls.backward(ctx, *cot_ts)
+            gs = gs if isinstance(gs, (tuple, list)) else (gs,)
+            if len(gs) != len(args):
+                # paddle allows returning grads only for tensor inputs
+                it = iter(gs)
+                gs = [next(it) if t is not None else None
+                      for t in tensor_inputs]
+            import numpy as np
+
+            import jax
+
+            def to_cot(t, g):
+                if g is None:
+                    # None = "no gradient" — hand the engine a float0 so
+                    # it skips this input (its _is_float0 convention)
+                    shape = tuple(t.data.shape) if t is not None else ()
+                    return np.zeros(shape, jax.dtypes.float0)
+                return g.data if isinstance(g, Tensor) else g
+
+            return tuple(to_cot(t, g)
+                         for t, g in zip(tensor_inputs, gs))
+
+        node = GradNode(
+            vjp_fn, tensor_inputs,
+            [(tuple(a.shape), a.dtype) for a in out_arrs],
+            name=cls.__name__, multi=multi, fn=None,
+            raw_args=tuple(a.data if isinstance(a, Tensor) else a
+                           for a in args))
+        wrapped = tuple(
+            Tensor(a, stop_gradient=False, _creator=(node, i))
+            for i, a in enumerate(out_arrs))
+        return wrapped if multi else wrapped[0]

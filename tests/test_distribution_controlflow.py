@@ -205,3 +205,57 @@ def test_unique_name_guard_prefix():
     assert a.full_name() != b.full_name()
     assert a.full_name().startswith("ns1_")
     assert g1 == "ns1_fc_0" and g2 == "ns2_fc_0"
+
+
+class _ExpLayer:
+    pass
+
+
+def test_pylayer_custom_backward():
+    """paddle.autograd.PyLayer: custom forward/backward pair on the
+    eager tape (reference python/paddle/autograd PyLayer)."""
+    import numpy as np
+    from paddle_tpu.autograd import PyLayer
+
+    calls = []
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x, scale):
+            ctx.save_for_backward(x)
+            return x * scale
+
+        @staticmethod
+        def backward(ctx, dy):
+            calls.append(1)
+            (x,) = ctx.saved_tensor()
+            return dy * 3.0  # deliberately NOT the true grad (2.0)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = Double.apply(x, 2.0)
+    np.testing.assert_allclose(np.asarray(y.data), [2.0, 4.0])
+    y.sum().backward()
+    assert calls  # the custom backward ran
+    np.testing.assert_allclose(np.asarray(x.grad.data), [3.0, 3.0])
+
+
+def test_pylayer_multi_output_and_none_grad():
+    import numpy as np
+    from paddle_tpu.autograd import PyLayer
+
+    class SplitScale(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return a * 2.0, b * 5.0
+
+        @staticmethod
+        def backward(ctx, da, db):
+            return da * 2.0, None  # b: no gradient
+
+    a = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    o1, o2 = SplitScale.apply(a, b)
+    (o1.sum() + o2.sum()).backward()
+    np.testing.assert_allclose(np.asarray(a.grad.data), 2.0)
+    assert b.grad is None  # None grad skipped cleanly
