@@ -186,19 +186,23 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
 
 def mean_iou(input, label, num_classes):
     """Mean intersection-over-union over a segmentation batch
-    (reference mean_iou_op.h / fluid.layers.mean_iou). Returns
-    (mean_iou, per_class_iou, present_mask)."""
+    (reference mean_iou_op.h). Matches the op's outputs exactly:
+    (mean_iou, out_wrong [C], out_correct [C]) where correct[c] counts
+    pixels with pred == label == c and a mismatching pixel increments
+    wrong[] for BOTH its predicted and true class; per-class
+    IoU = correct / (correct + wrong), averaged over classes with a
+    nonzero denominator."""
     import numpy as np
     pred = _np(input).astype(np.int64).reshape(-1)
     gt = _np(label).astype(np.int64).reshape(-1)
-    ious, present = [], []
-    for c in range(num_classes):
-        p = pred == c
-        g = gt == c
-        union = (p | g).sum()
-        present.append(bool(g.any() or p.any()))
-        ious.append(float((p & g).sum() / union) if union else 0.0)
-    ious = np.asarray(ious, np.float32)
-    present = np.asarray(present)
-    miou = float(ious[present].mean()) if present.any() else 0.0
-    return miou, ious, present
+    correct = np.zeros(num_classes, np.int64)
+    wrong = np.zeros(num_classes, np.int64)
+    hit = pred == gt
+    np.add.at(correct, pred[hit], 1)
+    np.add.at(wrong, pred[~hit], 1)
+    np.add.at(wrong, gt[~hit], 1)
+    denom = correct + wrong
+    valid = denom > 0
+    iou = correct / np.maximum(denom, 1)
+    miou = float(iou[valid].mean()) if valid.any() else 0.0
+    return miou, wrong, correct

@@ -139,7 +139,7 @@ def greedy_search(step_fn: Callable, init_state, batch_size: int,
 
 
 def viterbi_decode(potentials, transition, lengths=None,
-                   include_bos_eos_tag=False):
+                   include_bos_eos_tag=True):
     """CRF Viterbi decode (reference crf_decoding_op.h /
     paddle.text.viterbi_decode): emission potentials [B, T, N] +
     transition [N, N] -> (scores [B], best paths [B, T]).  One lax.scan

@@ -204,94 +204,110 @@ def prior_box(feature_h, feature_w, image_h, image_w, min_sizes,
     return Tensor(jnp.asarray(out.astype(np.float32)))
 
 
+def _roi_grid(ba, ph, pw, sr, spatial_scale, off):
+    """Shared RoI -> sample-point construction (pixel coords):
+    returns (fx, fy) [R, ph*sr, pw*sr] sample centers."""
+    x1 = ba[:, 0] * spatial_scale - off
+    y1 = ba[:, 1] * spatial_scale - off
+    rw = jnp.maximum(ba[:, 2] * spatial_scale - off - x1, 1e-3)
+    rh = jnp.maximum(ba[:, 3] * spatial_scale - off - y1, 1e-3)
+    ys = (jnp.arange(ph * sr) + 0.5) / sr          # bin units
+    xs = (jnp.arange(pw * sr) + 0.5) / sr
+    gy = y1[:, None] + rh[:, None] * ys[None, :] / ph   # [R, ph*sr]
+    gx = x1[:, None] + rw[:, None] * xs[None, :] / pw   # [R, pw*sr]
+    r = ba.shape[0]
+    fy = jnp.broadcast_to(gy[:, :, None], (r, ph * sr, pw * sr))
+    fx = jnp.broadcast_to(gx[:, None, :], (r, ph * sr, pw * sr))
+    return fx, fy
+
+
+def _roi_bilinear(xa, img_of, fx, fy):
+    """Bilinear-sample feature map points per RoI WITHOUT materializing
+    per-RoI feature copies: gathers only the sampled points.
+    xa [N, C, H, W]; img_of [R]; fx/fy [R, hs, ws] pixel coords.
+    Returns [R, hs, ws, C]; out-of-image points contribute zero."""
+    n, c, h, w = xa.shape
+    b = img_of[:, None, None]
+
+    def take(ix, iy):
+        inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        v = xa[b, :, iyc, ixc]                     # [R, hs, ws, C]
+        return jnp.where(inside[..., None], v, 0.0)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (fx - x0).astype(xa.dtype)[..., None]
+    wy = (fy - y0).astype(xa.dtype)[..., None]
+    return (take(x0, y0) * (1 - wx) * (1 - wy) +
+            take(x1, y0) * wx * (1 - wy) +
+            take(x0, y1) * (1 - wx) * wy +
+            take(x1, y1) * wx * wy)
+
+
+def _img_of(boxes_num, n, r):
+    if boxes_num is None:
+        return jnp.zeros((r,), jnp.int32)
+    bn = jnp.asarray(_arr(boxes_num), jnp.int32)
+    return jnp.repeat(jnp.arange(n, dtype=jnp.int32), bn,
+                      total_repeat_length=r)
+
+
+def _resolve_sr(sampling_ratio):
+    # the reference's sampling_ratio<=0 means per-RoI ADAPTIVE sampling
+    # (ceil(roi/pooled)); XLA needs static shapes, so a fixed 2x2 grid
+    # per bin stands in — values differ slightly from the adaptive
+    # kernel for large RoIs
+    return 2 if sampling_ratio <= 0 else int(sampling_ratio)
+
+
 def roi_align(x, boxes, boxes_num=None, output_size=7,
               spatial_scale=1.0, sampling_ratio=2, aligned=True):
     """RoIAlign (roi_align_op.h): bilinear-sample each RoI into a fixed
     [C, P, P] grid.  x: [N, C, H, W]; boxes: [R, 4] in image coords with
     boxes_num [N] mapping rows to batch images ([R] rois assumed all on
-    image 0 when boxes_num is None)."""
-    from ..nn.functional.vision import grid_sample
-
+    image 0 when boxes_num is None).  Differentiable in x."""
     ps = (output_size if isinstance(output_size, (tuple, list))
           else (output_size, output_size))
     ph, pw = int(ps[0]), int(ps[1])
-    xa = _arr(x)
-    ba = _arr(boxes).astype(jnp.float32)
-    n, c, h, w = xa.shape
-    r = ba.shape[0]
-    if boxes_num is None:
-        img_of = jnp.zeros((r,), jnp.int32)
-    else:
-        bn = jnp.asarray(_arr(boxes_num), jnp.int32)
-        img_of = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bn,
-                            total_repeat_length=r)
-
+    sr = _resolve_sr(sampling_ratio)
     off = 0.5 if aligned else 0.0
-    x1 = ba[:, 0] * spatial_scale - off
-    y1 = ba[:, 1] * spatial_scale - off
-    x2 = ba[:, 2] * spatial_scale - off
-    y2 = ba[:, 3] * spatial_scale - off
-    rw = jnp.maximum(x2 - x1, 1e-3)
-    rh = jnp.maximum(y2 - y1, 1e-3)
-    sr = max(int(sampling_ratio), 1)
 
-    # sample centers: for bin (i, j), sr x sr points
-    ys = (jnp.arange(ph * sr) + 0.5) / sr          # in bin units
-    xs = (jnp.arange(pw * sr) + 0.5) / sr
-    gy = y1[:, None] + rh[:, None] * ys[None, :] / ph       # [R, ph*sr]
-    gx = x1[:, None] + rw[:, None] * xs[None, :] / pw       # [R, pw*sr]
-    # normalized [-1, 1] for grid_sample (align_corners=True)
-    ngy = gy / jnp.maximum(h - 1, 1) * 2 - 1
-    ngx = gx / jnp.maximum(w - 1, 1) * 2 - 1
-    grid = jnp.stack(
-        [jnp.broadcast_to(ngx[:, None, :], (r, ph * sr, pw * sr)),
-         jnp.broadcast_to(ngy[:, :, None], (r, ph * sr, pw * sr))],
-        axis=-1)                                    # [R, phs, pws, 2]
-    per_roi_x = xa[img_of]                          # [R, C, H, W]
-    sampled = grid_sample(Tensor(per_roi_x), Tensor(grid),
-                          align_corners=True)
-    sa = _arr(sampled).reshape(r, c, ph, sr, pw, sr)
-    return Tensor(sa.mean(axis=(3, 5)))             # avg over samples
+    def fn(xa, ba):
+        n, ch = xa.shape[0], xa.shape[1]
+        r = ba.shape[0]
+        img_of = _img_of(boxes_num, n, r)
+        fx, fy = _roi_grid(ba.astype(jnp.float32), ph, pw, sr,
+                           spatial_scale, off)
+        sam = _roi_bilinear(xa, img_of, fx, fy)     # [R, hs, ws, C]
+        sam = jnp.moveaxis(sam, -1, 1).reshape(r, ch, ph, sr, pw, sr)
+        return sam.mean(axis=(3, 5))
+
+    return apply(fn, x, boxes, name="roi_align")
 
 
 def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
-    """RoIPool (roi_pool_op.h): max over each bin.  Implemented as
-    dense RoIAlign sampling followed by max (XLA-friendly fixed shapes;
-    exact argmax-bin parity is not preserved for degenerate rois)."""
-    from ..nn.functional.vision import grid_sample
-
+    """RoIPool (roi_pool_op.h): max over each bin.  Implemented as dense
+    bilinear sampling followed by max (fixed shapes; exact argmax-bin
+    parity is not preserved for degenerate rois).  Differentiable in x."""
     ps = (output_size if isinstance(output_size, (tuple, list))
           else (output_size, output_size))
     ph, pw = int(ps[0]), int(ps[1])
-    xa = _arr(x)
-    ba = _arr(boxes).astype(jnp.float32)
-    n, c, h, w = xa.shape
-    r = ba.shape[0]
-    if boxes_num is None:
-        img_of = jnp.zeros((r,), jnp.int32)
-    else:
-        bn = jnp.asarray(_arr(boxes_num), jnp.int32)
-        img_of = jnp.repeat(jnp.arange(n, dtype=jnp.int32), bn,
-                            total_repeat_length=r)
     sr = 2
-    x1 = ba[:, 0] * spatial_scale
-    y1 = ba[:, 1] * spatial_scale
-    rw = jnp.maximum(ba[:, 2] * spatial_scale - x1, 1e-3)
-    rh = jnp.maximum(ba[:, 3] * spatial_scale - y1, 1e-3)
-    ys = (jnp.arange(ph * sr) + 0.5) / sr
-    xs = (jnp.arange(pw * sr) + 0.5) / sr
-    gy = y1[:, None] + rh[:, None] * ys[None, :] / ph
-    gx = x1[:, None] + rw[:, None] * xs[None, :] / pw
-    ngy = gy / jnp.maximum(h - 1, 1) * 2 - 1
-    ngx = gx / jnp.maximum(w - 1, 1) * 2 - 1
-    grid = jnp.stack(
-        [jnp.broadcast_to(ngx[:, None, :], (r, ph * sr, pw * sr)),
-         jnp.broadcast_to(ngy[:, :, None], (r, ph * sr, pw * sr))],
-        axis=-1)
-    sampled = grid_sample(Tensor(xa[img_of]), Tensor(grid),
-                          align_corners=True)
-    sa = _arr(sampled).reshape(r, c, ph, sr, pw, sr)
-    return Tensor(sa.max(axis=(3, 5)))
+
+    def fn(xa, ba):
+        n, ch = xa.shape[0], xa.shape[1]
+        r = ba.shape[0]
+        img_of = _img_of(boxes_num, n, r)
+        fx, fy = _roi_grid(ba.astype(jnp.float32), ph, pw, sr,
+                           spatial_scale, 0.0)
+        sam = _roi_bilinear(xa, img_of, fx, fy)
+        sam = jnp.moveaxis(sam, -1, 1).reshape(r, ch, ph, sr, pw, sr)
+        return sam.max(axis=(3, 5))
+
+    return apply(fn, x, boxes, name="roi_pool")
 
 
 def detection_map(detections, gt_boxes, gt_labels,

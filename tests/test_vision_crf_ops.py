@@ -139,11 +139,11 @@ def test_crop():
 def test_mean_iou():
     pred = np.array([[0, 0, 1, 1]], np.int64)
     gt = np.array([[0, 1, 1, 1]], np.int64)
-    miou, ious, present = mean_iou(pred, gt, num_classes=3)
-    # class 0: inter 1, union 2 -> .5; class 1: inter 2, union 3 -> 2/3
-    assert ious[0] == pytest.approx(0.5)
-    assert ious[1] == pytest.approx(2 / 3)
-    assert not present[2]
+    miou, wrong, correct = mean_iou(pred, gt, num_classes=3)
+    # one mismatch (pred 0, gt 1) increments wrong for BOTH classes
+    np.testing.assert_array_equal(correct, [1, 2, 0])
+    np.testing.assert_array_equal(wrong, [1, 1, 0])
+    # class 0: 1/2; class 1: 2/3; class 2 has no pixels (excluded)
     assert miou == pytest.approx((0.5 + 2 / 3) / 2)
 
 
@@ -165,10 +165,23 @@ def test_viterbi_decode_matches_brute_force():
     em = rng.randn(b, t, n).astype(np.float32)
     tr = rng.randn(n, n).astype(np.float32)
     lengths = np.array([5, 3, 4], np.int64)
-    scores, paths = viterbi_decode(em, tr, lengths)
+    scores, paths = viterbi_decode(em, tr, lengths,
+                                   include_bos_eos_tag=False)
     for i in range(b):
         want_s, want_p = brute_viterbi(em[i], tr, int(lengths[i]))
         assert float(np.asarray(scores.data)[i]) == \
             pytest.approx(want_s, rel=1e-4), f"row {i}"
         got = tuple(np.asarray(paths.data)[i][:int(lengths[i])].tolist())
         assert got == want_p, f"row {i}: {got} vs {want_p}"
+
+
+def test_roi_align_differentiable():
+    """Review fix: roi_align must connect to autograd (a detection
+    backbone trains through it)."""
+    x = paddle.to_tensor(np.ones((1, 1, 8, 8), np.float32),
+                         stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+    V.roi_align(x, boxes, output_size=2).sum().backward()
+    g = np.asarray(x.grad.data)
+    assert g.sum() == pytest.approx(4.0, rel=1e-4)  # 2x2 bins of mean 1
+    assert (g >= 0).all() and g.max() > 0
