@@ -183,7 +183,7 @@ def bench_train_retry(config_name, batch, seq, steps, warmup,
         time.sleep(wait)
 
 
-def bench_flash(seqs=(1024, 2048, 4096)):
+def bench_flash(seqs=(1024, 2048, 4096), batch=8):
     """Secondary microbench: Pallas flash vs XLA composite, fwd+bwd."""
     import numpy as np
     import jax
@@ -194,19 +194,21 @@ def bench_flash(seqs=(1024, 2048, 4096)):
     rows = []
     for s in seqs:
         q = jnp.asarray(np.random.RandomState(0)
-                        .randn(4, s, 12, 64).astype(np.float32) * 0.1,
+                        .randn(batch, s, 12, 64).astype(np.float32) * 0.1,
                         dtype=jnp.bfloat16)
 
         def run(fn):
             lfn = jax.jit(jax.grad(
                 lambda q_, k_, v_: fn(q_, k_, v_).astype(jnp.float32)
                 .sum()))
-            g = lfn(q, q, q)
-            g.block_until_ready()
+            # host transfer forces real sync: block_until_ready returns
+            # early on the remote backend (measured 0.02ms "timings")
+            float(lfn(q, q, q).astype(jnp.float32).sum())
             n, t0 = 10, time.perf_counter()
+            g = None
             for _ in range(n):
                 g = lfn(q, q, q)
-            g.block_until_ready()
+            float(g.astype(jnp.float32).sum())
             return (time.perf_counter() - t0) / n * 1e3
 
         comp_ms = run(lambda a, b, c: _sdpa_reference(
@@ -345,6 +347,19 @@ def main():
             consider(off)  # audit trail: the A/B row joins candidates
         except Exception as e:
             log(f"  flash A/B skipped: {type(e).__name__}: {str(e)[:200]}")
+    if on_tpu and result["use_flash"] and flash_speedup is None:
+        # full-step composite compile flaked: the attention-only
+        # microbench is a tiny program the degraded compile helper still
+        # accepts — kernel-vs-composite evidence, honestly labeled
+        try:
+            rows = bench_flash(seqs=(result["seq"],))
+            if rows and "speedup" in rows[0]:
+                flash_speedup = rows[0]["speedup"]
+                log(f"  flash A/B fallback (attention microbench): "
+                    f"{flash_speedup}x")
+        except Exception as e:
+            log(f"  flash microbench fallback failed: "
+                f"{type(e).__name__}: {str(e)[:200]}")
 
     out = {
         "metric": "gpt_train_mfu",
