@@ -347,12 +347,14 @@ def main():
             consider(off)  # audit trail: the A/B row joins candidates
         except Exception as e:
             log(f"  flash A/B skipped: {type(e).__name__}: {str(e)[:200]}")
-    if on_tpu and result["use_flash"] and flash_speedup is None:
+    if on_tpu and result["use_flash"] and flash_speedup is None \
+            and not result["pathological"]:
         # full-step composite compile flaked: the attention-only
         # microbench is a tiny program the degraded compile helper still
         # accepts — kernel-vs-composite evidence, honestly labeled
         try:
-            rows = bench_flash(seqs=(result["seq"],))
+            rows = bench_flash(seqs=(result["seq"],),
+                               batch=result["batch"])
             if rows and "speedup" in rows[0]:
                 flash_speedup = rows[0]["speedup"]
                 log(f"  flash A/B fallback (attention microbench): "

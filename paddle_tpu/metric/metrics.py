@@ -198,9 +198,14 @@ def mean_iou(input, label, num_classes):
     correct = np.zeros(num_classes, np.int64)
     wrong = np.zeros(num_classes, np.int64)
     hit = pred == gt
-    np.add.at(correct, pred[hit], 1)
-    np.add.at(wrong, pred[~hit], 1)
-    np.add.at(wrong, gt[~hit], 1)
+
+    def in_range(a):
+        return (a >= 0) & (a < num_classes)
+
+    # out-of-range ids (ignore_index-style labels) contribute nothing
+    np.add.at(correct, pred[hit & in_range(pred)], 1)
+    np.add.at(wrong, pred[~hit & in_range(pred)], 1)
+    np.add.at(wrong, gt[~hit & in_range(gt)], 1)
     denom = correct + wrong
     valid = denom > 0
     iou = correct / np.maximum(denom, 1)

@@ -225,26 +225,29 @@ def _roi_bilinear(xa, img_of, fx, fy):
     """Bilinear-sample feature map points per RoI WITHOUT materializing
     per-RoI feature copies: gathers only the sampled points.
     xa [N, C, H, W]; img_of [R]; fx/fy [R, hs, ws] pixel coords.
-    Returns [R, hs, ws, C]; out-of-image points contribute zero."""
+    Border rule matches roi_align_op.h bilinear_interpolate: coords in
+    [-1, 0] (or [size-1, size]) clamp to the border pixel with full
+    weight; only points beyond that contribute zero."""
     n, c, h, w = xa.shape
     b = img_of[:, None, None]
+    valid = (fx >= -1.0) & (fx <= w) & (fy >= -1.0) & (fy <= h)
+    fxc = jnp.clip(fx, 0.0, w - 1.0)
+    fyc = jnp.clip(fy, 0.0, h - 1.0)
+    x0 = jnp.floor(fxc).astype(jnp.int32)
+    y0 = jnp.floor(fyc).astype(jnp.int32)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    wx = (fxc - x0).astype(xa.dtype)[..., None]
+    wy = (fyc - y0).astype(xa.dtype)[..., None]
 
     def take(ix, iy):
-        inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
-        ixc = jnp.clip(ix, 0, w - 1)
-        iyc = jnp.clip(iy, 0, h - 1)
-        v = xa[b, :, iyc, ixc]                     # [R, hs, ws, C]
-        return jnp.where(inside[..., None], v, 0.0)
+        return xa[b, :, iy, ix]                    # [R, hs, ws, C]
 
-    x0 = jnp.floor(fx).astype(jnp.int32)
-    y0 = jnp.floor(fy).astype(jnp.int32)
-    x1, y1 = x0 + 1, y0 + 1
-    wx = (fx - x0).astype(xa.dtype)[..., None]
-    wy = (fy - y0).astype(xa.dtype)[..., None]
-    return (take(x0, y0) * (1 - wx) * (1 - wy) +
-            take(x1, y0) * wx * (1 - wy) +
-            take(x0, y1) * (1 - wx) * wy +
-            take(x1, y1) * wx * wy)
+    out = (take(x0, y0) * (1 - wx) * (1 - wy) +
+           take(x1, y0) * wx * (1 - wy) +
+           take(x0, y1) * (1 - wx) * wy +
+           take(x1, y1) * wx * wy)
+    return jnp.where(valid[..., None], out, 0.0)
 
 
 def _img_of(boxes_num, n, r):

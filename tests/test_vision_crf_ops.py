@@ -185,3 +185,22 @@ def test_roi_align_differentiable():
     g = np.asarray(x.grad.data)
     assert g.sum() == pytest.approx(4.0, rel=1e-4)  # 2x2 bins of mean 1
     assert (g >= 0).all() and g.max() > 0
+
+
+def test_roi_align_border_clamp_and_mean_iou_ignore_index():
+    """Review fixes: border samples clamp to the edge pixel with full
+    weight (reference bilinear_interpolate), and out-of-range labels
+    (ignore_index) contribute nothing to mean_iou."""
+    x = paddle.to_tensor(np.ones((1, 1, 8, 8), np.float32))
+    # tiny edge RoI: aligned sampling puts centers slightly outside;
+    # on an all-ones map every bin must still be exactly 1.0
+    boxes = paddle.to_tensor(np.array([[0, 0, 1, 1]], np.float32))
+    out = np.asarray(V.roi_align(x, boxes, output_size=2).data)
+    np.testing.assert_allclose(out, 1.0, atol=1e-6)
+
+    pred = np.array([0, 1, 1], np.int64)
+    gt = np.array([0, 255, -1], np.int64)   # ignore labels
+    miou, wrong, correct = mean_iou(pred, gt, num_classes=2)
+    np.testing.assert_array_equal(correct, [1, 0])
+    # the two mismatches count the (in-range) predicted class only
+    np.testing.assert_array_equal(wrong, [0, 2])
