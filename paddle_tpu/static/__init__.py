@@ -13,9 +13,10 @@ from ..core.dtype import convert_dtype
 from . import control_flow as _cf  # noqa: E402
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from .program import (  # noqa: F401
-    Executor, Program, Variable, default_main_program,
+    Executor, InferenceProgram, Program, Variable, default_main_program,
     default_startup_program, disable_static, enable_static,
-    in_static_mode, program_guard)
+    in_static_mode, load_inference_model, program_guard,
+    save_inference_model)
 
 
 class nn:
@@ -31,7 +32,8 @@ __all__ = ["InputSpec", "data", "cond", "while_loop", "case",
            "switch_case", "nn", "Executor", "Program", "Variable",
            "program_guard", "default_main_program",
            "default_startup_program", "enable_static", "disable_static",
-           "in_static_mode"]
+           "in_static_mode", "save_inference_model",
+           "load_inference_model", "InferenceProgram"]
 
 
 class InputSpec:

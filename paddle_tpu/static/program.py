@@ -35,7 +35,9 @@ import jax.numpy as jnp
 
 __all__ = ["Variable", "Program", "Executor", "program_guard",
            "default_main_program", "default_startup_program",
-           "enable_static", "disable_static", "in_static_mode"]
+           "enable_static", "disable_static", "in_static_mode",
+           "save_inference_model", "load_inference_model",
+           "InferenceProgram"]
 
 _state = threading.local()
 
@@ -331,6 +333,9 @@ class Executor:
             return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        if isinstance(program, InferenceProgram):
+            outs = program.run(feed)
+            return [np.asarray(o) for o in outs] if return_numpy else outs
         fetch_list = list(fetch_list or [])
         if not fetch_list and not program._train and not program.nodes:
             return []  # startup program: params already initialized
@@ -446,3 +451,92 @@ def install_minimize(program: Program, loss: Variable, optimizer):
             "minimize(loss): no trainable Parameters feed this loss")
     program._train = (loss, params, optimizer)
     program._version += 1
+
+
+class InferenceProgram:
+    """Deserialized save_inference_model artifact: a compiled feed/fetch
+    function Executor.run can execute (reference load_inference_model
+    returns a pruned Program; here the pruned program IS the serialized
+    StableHLO export)."""
+
+    def __init__(self, exported, feed_names, n_outputs):
+        self.exported = exported
+        self.feed_names = list(feed_names)
+        self.n_outputs = int(n_outputs)
+
+    def run(self, feed):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"feed is missing inputs: {missing}")
+        args = [jnp.asarray(feed[n]) for n in self.feed_names]
+        out = self.exported.call(*args)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, **configs) -> str:
+    """Export the pruned static subgraph feeding `fetch_vars` as
+    serialized StableHLO with parameters BAKED at save time (reference
+    static.save_inference_model: prune + freeze persistables).
+    feed_vars order defines the feed signature."""
+    import pickle
+
+    from jax import export as jexport
+
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    nodes, caps, input_vars = _collect(fetch_vars)
+    declared = {id(v) for v in feed_vars}
+    extra = [v.name for v in input_vars if id(v) not in declared]
+    if extra:
+        raise ValueError(
+            f"fetch_vars depend on inputs not in feed_vars: {extra}")
+
+    cap_arrays = [t.data for t in caps]  # frozen at save time
+
+    def fn(*feed_arrays):
+        env_feeds = {id(v): a for v, a in zip(feed_vars, feed_arrays)}
+        ordered = [env_feeds[id(v)] for v in input_vars]
+        return tuple(_run_graph(nodes, caps, input_vars, fetch_vars,
+                                cap_arrays, ordered))
+
+    # None dims export as SYMBOLIC dims so the artifact serves any batch
+    avals = []
+    scope = jexport.SymbolicScope()
+    n_sym = 0
+    for v in feed_vars:
+        dims = []
+        for s in v.shape:
+            if s in (None, -1):
+                n_sym += 1
+                dims.append(jexport.symbolic_shape(
+                    f"d{n_sym}", scope=scope)[0])
+            else:
+                dims.append(int(s))
+        avals.append(jax.ShapeDtypeStruct(tuple(dims), v.dtype))
+    exported = jexport.export(jax.jit(fn))(*avals)
+
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    meta = {"feed_names": [v.name for v in feed_vars],
+            "n_outputs": len(fetch_vars)}
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """Returns (InferenceProgram, feed_names, fetch_count) — the
+    reference's [program, feed_target_names, fetch_targets] shape."""
+    import pickle
+
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = InferenceProgram(exported, meta["feed_names"],
+                            meta["n_outputs"])
+    return prog, prog.feed_names, prog.n_outputs

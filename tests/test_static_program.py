@@ -232,3 +232,40 @@ def test_static_adam_bias_correction_advances():
     paddle.disable_static()
     np.testing.assert_allclose(st_losses, dy_losses, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    """static.save_inference_model exports the pruned graph with frozen
+    params; load_inference_model returns a program Executor.run serves —
+    across batch sizes (symbolic dims)."""
+    paddle.enable_static()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = static.data("x", [None, 4])
+    y = net(x)
+    exe = static.Executor()
+    a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    (want,) = exe.run(feed={"x": a}, fetch_list=[y])
+
+    prefix = str(tmp_path / "infer" / "net")
+    static.save_inference_model(prefix, [x], [y], exe)
+    paddle.disable_static()
+
+    prog, feed_names, n_out = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    (got,) = static.Executor().run(prog, feed={"x": a})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # params are FROZEN at save time: later weight changes don't leak in
+    # and a different batch size serves through the symbolic dim
+    b = np.ones((7, 4), np.float32)
+    (got7,) = static.Executor().run(prog, feed={"x": b})
+    assert got7.shape == (7, 2)
+
+
+def test_save_inference_model_validates_feeds(tmp_path):
+    paddle.enable_static()
+    x = static.data("x", [None, 2])
+    z = static.data("z", [None, 2])
+    out = x + z
+    with pytest.raises(ValueError, match="not in feed_vars"):
+        static.save_inference_model(str(tmp_path / "m"), [x], [out])
