@@ -25,7 +25,8 @@ import jax
 
 from .core.autograd import apply
 
-__all__ = ["register_op", "get_op", "list_ops", "CustomOp"]
+__all__ = ["register_op", "get_op", "list_ops", "CustomOp",
+           "py_func"]
 
 _REGISTRY: Dict[str, "CustomOp"] = {}
 
@@ -121,3 +122,87 @@ def get_op(name: str) -> CustomOp:
 
 def list_ops():
     return sorted(_REGISTRY)
+
+
+def py_func(func, x, out, backward_func=None, name="py_func"):
+    """Run an arbitrary host-Python (numpy) function as a framework op —
+    reference fluid.layers.py_func (py_func_op.cc): the escape hatch for
+    logic with no device kernel.
+
+    x: input Tensor or list; out: output template(s) — (shape, dtype)
+    tuples or Tensors whose shape/dtype declare the result;
+    backward_func(inputs, outputs, out_grads) -> per-input grads (numpy),
+    optional.  The callback runs on the HOST even inside jit/to_static
+    (jax.pure_callback), so it must be pure and shape-stable.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+
+    def is_template(o):
+        if isinstance(o, Tensor):
+            return True
+        return (isinstance(o, (tuple, list)) and len(o) == 2 and
+                isinstance(o[0], (tuple, list)) and
+                (isinstance(o[1], str) or hasattr(o[1], "name")))
+
+    # `out` is a LIST of templates only when it isn't itself one
+    # ((shape, dtype) is a tuple too)
+    multi = isinstance(out, (list, tuple)) and not is_template(out)
+    outs = list(out) if multi else [out]
+
+    def tmpl(o):
+        if isinstance(o, Tensor):
+            return jax.ShapeDtypeStruct(tuple(o.data.shape), o.data.dtype)
+        shape, dtype = o
+        return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+    result_sdt = tuple(tmpl(o) for o in outs)
+
+    def host_fwd(*arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, result_sdt))
+
+    def fwd_fn(*arrs):
+        res = jax.pure_callback(host_fwd, result_sdt, *arrs)
+        return tuple(res) if multi else res[0]
+
+    if backward_func is None:
+        return apply(fwd_fn, *xs, name=name)
+
+    wrapped = jax.custom_vjp(fwd_fn)
+
+    def _f(*arrs):
+        o = wrapped(*arrs)
+        return o, (arrs, tuple(o) if multi else (o,))
+
+    def _b(res, cots):
+        arrs, fwd_out = res
+        cot_t = tuple(cots) if multi else (cots,)
+        in_sdt = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       for a in arrs)
+
+        def host_bwd(*flat):
+            n_in = len(arrs)
+            n_out = len(fwd_out)
+            ins = [np.asarray(v) for v in flat[:n_in]]
+            outs_ = [np.asarray(v) for v in flat[n_in:n_in + n_out]]
+            gs_ = [np.asarray(v) for v in flat[n_in + n_out:]]
+            g = backward_func(ins, outs_, gs_)
+            g = g if isinstance(g, (list, tuple)) else (g,)
+            return tuple(
+                np.zeros(s.shape, s.dtype) if gi is None
+                else np.asarray(gi, dtype=s.dtype).reshape(s.shape)
+                for gi, s in zip(g, in_sdt))
+
+        return jax.pure_callback(host_bwd, in_sdt, *arrs, *fwd_out,
+                                 *cot_t)
+
+    wrapped.defvjp(_f, _b)
+    return apply(wrapped, *xs, name=name)
