@@ -18,6 +18,10 @@ Layer map vs the reference (SURVEY.md §1):
 from __future__ import annotations
 
 __version__ = "0.1.0"
+full_version = __version__
+# reference paddle.version exports a build commit id; stamped at package
+# build in the reference, a constant here
+commit = "unknown"
 
 import warnings as _warnings
 
@@ -43,17 +47,41 @@ from .tensor import *  # noqa: F401,F403
 from .tensor import tensor_methods as _tensor_methods  # noqa: F401  (patch Tensor)
 
 from . import tensor  # noqa: F401
+# `from .tensor import *` leaks tensor's submodule objects (math, linalg,
+# ...) into this namespace because tensor/__init__ has no __all__; the
+# public paddle.linalg namespace must be the dedicated module. NB a plain
+# `from . import linalg` would return the leaked attribute, not import.
+import importlib as _importlib
+linalg = _importlib.import_module(".linalg", __name__)
 from . import device  # noqa: F401
-from .device import CPUPlace, CUDAPlace, TPUPlace, get_device, set_device  # noqa: F401
+from .device import (CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace,  # noqa: F401
+                     XPUPlace, get_device, set_device,
+                     is_compiled_with_cuda, is_compiled_with_xpu)
+
+# the reference's dygraph VarBase role is played by Tensor directly
+VarBase = Tensor
+
+
+def get_cudnn_version():
+    """Reference paddle.get_cudnn_version — no cuDNN on TPU."""
+    return None
+
+
+def get_cuda_rng_state():
+    """Reference CUDA rng-state accessors map onto the single JAX key
+    state (there is no separate device generator)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
 
 # Subpackages imported lazily to keep import light and avoid cycles.
-import importlib as _importlib
-
 _LAZY_MODULES = (
     "nn", "optimizer", "io", "metric", "amp", "jit", "static",
     "distributed", "vision", "text", "hapi", "callbacks", "profiler",
     "framework", "regularizer", "linalg", "distribution", "incubate",
-    "utils", "models", "autograd", "extension",
+    "utils", "models", "autograd", "extension", "onnx",
 )
 
 
@@ -92,11 +120,52 @@ def __getattr__(name):
     if name == "flops":
         from .hapi.model_summary import flops as _flops
         return _flops
+    if name == "ParamAttr":
+        from .nn.layer_base import ParamAttr as _PA
+        return _PA
+    if name == "create_parameter":
+        from .static import create_parameter as _cp
+        return _cp
+    if name == "py_func":
+        from .extension import py_func as _pf
+        return _pf
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
+def monkey_patch_math_varbase():
+    """reference fluid/dygraph/math_op_patch.py entry point: binds the
+    op library onto Tensor. Runs at import here; calling it re-binds
+    (idempotent) so late-registered ops become methods too."""
+    _tensor_methods._bind()
+
+
+def monkey_patch_variable():
+    """reference fluid/layers/math_op_patch.py: operator overloads on
+    static Variables — built into static/program.py Variable here."""
+    return None
+
+
+def in_dygraph_mode():
+    """Reference paddle.in_dygraph_mode (alias of in_dynamic_mode)."""
+    return in_dynamic_mode()
+
+
+def enable_dygraph(place=None):
+    """Reference paddle.enable_dygraph == leaving static mode."""
+    return disable_static(place)
+
+
+def disable_dygraph():
+    """Reference paddle.disable_dygraph == entering static mode."""
+    return enable_static()
+
+
 def in_dynamic_mode():
-    """True when executing eagerly (reference paddle.in_dynamic_mode)."""
+    """True when executing eagerly (reference paddle.in_dynamic_mode):
+    False inside jit tracing AND while static-graph mode is enabled."""
+    from .static import in_static_mode
+    if in_static_mode():
+        return False
     try:
         from .jit.api import in_tracing
         return not in_tracing()

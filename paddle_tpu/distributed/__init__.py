@@ -7,7 +7,8 @@ compiled programs, exposed eagerly through this package's API for
 dygraph-style parity.
 """
 from .env import (  # noqa: F401
-    get_rank, get_world_size, init_parallel_env, is_initialized)
+    ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    is_initialized)
 from .mesh import (  # noqa: F401
     Mesh, get_mesh, set_mesh, create_mesh, mesh_axis_size)
 from .collective import (  # noqa: F401

@@ -104,3 +104,49 @@ def heartbeat(min_interval: float = 1.0) -> bool:
     except OSError:
         return False
     return True
+
+
+class ParallelEnv:
+    """reference fluid/dygraph/parallel.py:68 ParallelEnv — env-derived
+    rank/world_size/device info for dygraph DDP (prefer get_rank() /
+    get_world_size())."""
+
+    def __init__(self):
+        import os
+        self._rank = get_rank()
+        self._world_size = get_world_size()
+        self._device_id = int(os.environ.get("FLAGS_selected_tpus",
+                              os.environ.get("FLAGS_selected_gpus", "0"))
+                              .split(",")[0] or 0)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return get_current_endpoint() or ""
+
+    @property
+    def trainer_endpoints(self):
+        return get_endpoints() or []

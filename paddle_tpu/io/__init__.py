@@ -18,5 +18,6 @@ from .dataset import (  # noqa: F401
 from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         WorkerInfo, get_worker_info)
 from .in_memory import InMemoryDataset  # noqa: F401

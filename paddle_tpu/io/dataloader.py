@@ -156,6 +156,7 @@ class _MultiprocessIter:
         def work(w, ring_name, batches):
             ring = ShmRing.attach(ring_name)
             try:
+                _set_worker_info(WorkerInfo(w, W, ds))
                 if init_fn is not None:
                     init_fn(w)
                 for idxs in batches:
@@ -337,3 +338,31 @@ class DataLoader:
 
     def __call__(self):
         return self.__iter__()
+
+
+class WorkerInfo:
+    """reference dataloader_iter.py WorkerInfo: id / num_workers /
+    dataset visible inside a worker process."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+_WORKER_INFO = None
+
+
+def get_worker_info():
+    """reference fluid/dataloader/dataloader_iter.py:133 — WorkerInfo in
+    a dataloader worker process, None in the main process."""
+    return _WORKER_INFO
+
+
+def _set_worker_info(info):
+    global _WORKER_INFO
+    _WORKER_INFO = info

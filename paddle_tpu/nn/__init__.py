@@ -5,8 +5,14 @@ Layer system over the eager tape / functional bridge; see layer_base.py.
 from .layer_base import Layer, ParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import weight_norm_hook  # noqa: F401
+from .functional import extension  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
+from .layer import vision  # noqa: F401
+from .layer.distance import PairwiseDistance  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
 

@@ -1,0 +1,110 @@
+"""paddle.nn.BeamSearchDecoder + dynamic_decode (reference
+python/paddle/nn/decode.py over fluid/layers/rnn.py BeamSearchDecoder /
+dynamic_decode).
+
+The reference unrolls decoding with a While loop over LoDTensorArrays;
+here the whole search is one compiled lax.scan (text/decoding.py
+beam_search) — the TPU-native shape of the same API: the decoder bundles
+cell + embedding + output projection, dynamic_decode runs it to
+max_step_num and returns beam-sorted ids.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding driver around an RNN cell.
+
+    cell: an RNNCellBase (SimpleRNNCell/LSTMCell/GRUCell) or any callable
+      (inputs [N, E], states) -> (outputs [N, H], new_states).
+    embedding_fn: token ids [N] -> embeddings [N, E] (defaults to one-hot
+      of vocab_size inferred from output_fn if omitted — pass it).
+    output_fn: cell outputs [N, H] -> vocab logits [N, V].
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (reference staticmethod of the same
+        name): repeat each batch row beam_size times."""
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(a, beam_size, axis=0)
+        return Tensor(tiled) if isinstance(x, Tensor) else tiled
+
+    def _step_fn(self):
+        def step(tokens, state):
+            if self.embedding_fn is not None:
+                emb = self.embedding_fn(tokens)
+            else:
+                raise ValueError("BeamSearchDecoder needs embedding_fn")
+            emb = emb.data if isinstance(emb, Tensor) else emb
+            out, new_state = self._call_cell(emb, state)
+            logits = out if self.output_fn is None else self.output_fn(out)
+            logits = logits.data if isinstance(logits, Tensor) else logits
+            return logits, new_state
+        return step
+
+    def _call_cell(self, inputs, states):
+        res = self.cell(inputs, states)
+        out, new_states = res
+        out = out.data if isinstance(out, Tensor) else out
+        new_states = jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else t, new_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run the decoder to max_step_num steps (reference dynamic_decode).
+
+    inits: initial cell state with leading batch dim B (it is tiled to
+    B*beam internally). Returns (predicted_ids, scores) — ids
+    [B, T, beam] (or [T, B, beam] when output_time_major), beam-sorted
+    best first — plus per-beam lengths when return_length.
+    """
+    from ..text.decoding import beam_search
+
+    if inits is None:
+        raise ValueError("dynamic_decode needs the initial cell state")
+    K = decoder.beam_size
+
+    def prep(t):
+        a = t.data if isinstance(t, Tensor) else jnp.asarray(t)
+        return jnp.repeat(a, K, axis=0)
+
+    state0 = jax.tree_util.tree_map(
+        prep, inits, is_leaf=lambda t: isinstance(t, Tensor))
+    leaves = jax.tree_util.tree_leaves(state0)
+    B = leaves[0].shape[0] // K
+
+    seqs, scores = beam_search(
+        decoder._step_fn(), state0, batch_size=B, beam_size=K,
+        max_len=int(max_step_num), bos_id=decoder.start_token,
+        eos_id=decoder.end_token)
+    ids = jnp.moveaxis(seqs.data, 1, 2)            # [B, T, K]
+    if output_time_major:
+        ids = jnp.moveaxis(ids, 0, 1)              # [T, B, K]
+    out = (Tensor(ids), scores)
+    if return_length:
+        eos_hit = (seqs.data == decoder.end_token)
+        T = seqs.data.shape[2]
+        first = jnp.argmax(eos_hit.astype(jnp.int32), axis=2) + 1
+        lengths = jnp.where(eos_hit.any(axis=2), first, T)
+        return out + (Tensor(lengths.astype(jnp.int64)),)
+    return out

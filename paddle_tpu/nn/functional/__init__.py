@@ -9,3 +9,5 @@ from .loss import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention)
+from . import extension  # noqa: F401
+from .extension import diag_embed, gather_tree  # noqa: F401

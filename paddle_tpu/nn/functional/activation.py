@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from ...core.autograd import apply
 
 __all__ = [
-    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "relu", "relu6", "relu_", "elu", "elu_", "selu", "celu", "gelu", "sigmoid",
     "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "softshrink",
     "tanhshrink", "leaky_relu", "log_sigmoid", "log_softmax", "softmax",
     "softmax_", "softplus", "softsign", "swish", "silu", "mish", "tanh",
@@ -218,3 +218,9 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = y + jax.lax.stop_gradient(y_hard - y)
         return y
     return apply(fn, x, name="gumbel_softmax")
+
+
+def elu_(x, alpha=1.0, name=None):
+    """In-place elu (reference elu_)."""
+    x._data = jax.nn.elu(x.data, alpha)
+    return x

@@ -1,0 +1,7 @@
+"""paddle.nn.functional.extension parity (reference
+python/paddle/nn/functional/extension.py — diag_embed; gather_tree is
+exported beside it from fluid.layers in the reference __init__)."""
+from ...tensor.creation import diag_embed  # noqa: F401
+from ...text.decoding import gather_tree  # noqa: F401
+
+__all__ = ["diag_embed", "gather_tree"]

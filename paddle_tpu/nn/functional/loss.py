@@ -21,6 +21,7 @@ __all__ = [
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "ctc_loss", "log_loss", "square_error_cost",
     "sigmoid_focal_loss", "triplet_margin_loss", "dice_loss",
+    "hsigmoid_loss",
     "npair_loss",
 ]
 
@@ -347,3 +348,59 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
         return ce + reg
 
     return apply(fn, anchor, positive, labels, name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference hierarchical_sigmoid_op.cc,
+    MatrixBitCodeFunctor with SimpleCodeTable in
+    operators/math/matrix_bit_code.h).
+
+    Default tree = the reference's SimpleCode complete binary tree over
+    `num_classes` leaves: for class c the heap code is c + num_classes;
+    internal node for bit j is (code >> (j+1)) - 1 and the target bit is
+    (code >> j) & 1. A custom tree comes in as (path_table, path_code)
+    [N, L] padded with -1. Returns [N, 1] per-sample losses.
+
+    is_sparse selects the reference's SelectedRows gradient for the
+    weight table; on TPU the row gather below already yields a sparse
+    (gather-transpose) gradient under XLA, so it is accepted and ignored.
+    """
+    args = [input, label, weight]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(bias)
+    custom = path_table is not None
+    if custom:
+        args += [path_table, path_code]
+
+    max_len = max((2 * num_classes - 1).bit_length() - 1, 1) \
+        if not custom else None
+
+    def fn(x, lab, w, *rest):
+        b = rest[0] if has_bias else None
+        lab = lab.reshape(-1).astype(jnp.int32)
+        if custom:
+            tbl = rest[-2].astype(jnp.int32)
+            code = rest[-1].astype(jnp.int32)
+            valid = (tbl >= 0).astype(jnp.float32)
+            idx = jnp.maximum(tbl, 0)                      # [N, L]
+            bits = code.astype(jnp.float32)
+        else:
+            c = lab + num_classes                          # [N]
+            js = jnp.arange(max_len, dtype=jnp.int32)      # [L]
+            idx = (c[:, None] >> (js[None, :] + 1)) - 1    # [N, L]
+            bits = ((c[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+            valid = (idx >= 0).astype(jnp.float32)
+            idx = jnp.maximum(idx, 0)
+        rows = w[idx]                                      # [N, L, F]
+        s = jnp.einsum("nf,nlf->nl", x.astype(jnp.float32),
+                       rows.astype(jnp.float32))
+        if b is not None:
+            s = s + b.reshape(-1)[idx].astype(jnp.float32)
+        # BCE-with-logits toward the code bit, masked to the real path
+        per_bit = jax.nn.softplus(s) - bits * s
+        return jnp.sum(per_bit * valid, axis=1, keepdims=True)
+
+    return apply(fn, *args, name="hsigmoid_loss")

@@ -1,0 +1,30 @@
+"""paddle.nn.PairwiseDistance (reference nn/layer/distance.py — the
+p-norm of x-y along the last axis via dist/p_norm kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ..layer_base import Layer
+
+__all__ = ["PairwiseDistance"]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def fn(a, b):
+            d = (a - b).astype(jnp.float32) + eps
+            if p == float("inf"):
+                return jnp.max(jnp.abs(d), axis=-1, keepdims=keep)
+            return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                           keepdims=keep) ** (1.0 / p)
+
+        return apply(fn, x, y, name="pairwise_distance")

@@ -7,6 +7,7 @@ from ..layer_base import Layer
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
+           "HSigmoidLoss",
            "CTCLoss", "TripletMarginLoss"]
 
 
@@ -158,3 +159,37 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss over hierarchical_sigmoid_op.cc); owns the internal-node
+    weight [num_classes-1, feature_size] and optional bias."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default "
+                             "complete-binary tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        import math
+        from .. import initializer as I
+        bound = math.sqrt(1.0 / feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [num_classes - 1, 1], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("is_custom=True needs path_table + path_code")
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, bias=self.bias,
+            path_table=path_table, path_code=path_code,
+            is_sparse=self.is_sparse)

@@ -21,7 +21,7 @@ from ...core.tensor import Tensor
 from .. import initializer as I
 from ..layer_base import Layer
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
            "LSTM", "GRU", "BiRNN"]
 
 

@@ -1,0 +1,7 @@
+"""paddle.nn.vision namespace (reference python/paddle/nn/layer/vision.py
+— PixelShuffle; the upsampling layers live beside it in common.py here)."""
+from .common import (PixelShuffle, PixelUnshuffle, ChannelShuffle,  # noqa: F401
+                     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D)
+
+__all__ = ["PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+           "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D"]

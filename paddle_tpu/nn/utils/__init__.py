@@ -1,0 +1,5 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/__init__.py)."""
+from . import weight_norm_hook  # noqa: F401
+from .weight_norm_hook import weight_norm, remove_weight_norm  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm"]

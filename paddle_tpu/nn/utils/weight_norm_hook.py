@@ -1,0 +1,97 @@
+"""Weight normalization (reference
+python/paddle/nn/utils/weight_norm_hook.py:155,202 — weight_norm /
+remove_weight_norm).
+
+Reparameterizes layer.weight as g * v / ||v|| where the norm is taken
+over every axis except `dim`. Implemented the reference's way: replace
+the parameter with (weight_g, weight_v) and recompute `weight` in a
+forward pre-hook so autograd flows into g and v.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v, dim):
+    def fn(a):
+        if dim is None:
+            return jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        axes = tuple(i for i in range(a.ndim) if i != dim % a.ndim)
+        return jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2, axis=axes,
+                                keepdims=True)).astype(a.dtype)
+    return apply(fn, v, name="norm_except_dim")
+
+
+def _compute_weight(g, v, dim):
+    def fn(ga, va):
+        if dim is None:
+            n = jnp.sqrt(jnp.sum(va.astype(jnp.float32) ** 2))
+            return (ga * va / n).astype(va.dtype)
+        axes = tuple(i for i in range(va.ndim) if i != dim % va.ndim)
+        n = jnp.sqrt(jnp.sum(va.astype(jnp.float32) ** 2, axis=axes,
+                             keepdims=True))
+        return (ga.astype(jnp.float32) * va.astype(jnp.float32) / n) \
+            .astype(va.dtype)
+    return apply(fn, g, v, name="weight_norm")
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        setattr(layer, self.name, _compute_weight(g, v, self.dim))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to `layer.<name>`; returns the layer."""
+    if hasattr(layer, "_weight_norm_hooks") and \
+            name in layer._weight_norm_hooks:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"{type(layer).__name__} has no parameter "
+                         f"{name!r}")
+    g0 = _norm_except_dim(w, dim)
+    v0 = w
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(g0.data))
+    layer.add_parameter(name + "_v", Parameter(v0.data))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        layer._weight_norm_hooks = {}
+    layer._weight_norm_hooks[name] = (hook, handle)
+    # keep a usable .weight between calls (eval-time access)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Undo weight_norm: restore a single `name` parameter."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    hook, handle = hooks.pop(name)
+    handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = _compute_weight(g, v, hook.dim)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if hasattr(layer, name):
+        try:
+            delattr(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, Parameter(w.data))
+    return layer

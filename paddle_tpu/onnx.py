@@ -1,0 +1,25 @@
+"""paddle.onnx (reference python/paddle/onnx/__init__.py — `export`
+backed by the paddle2onnx converter package).
+
+The TPU-native portable export is StableHLO (`paddle.jit.save`), which
+any PJRT/OpenXLA runtime can load; ONNX serialization additionally
+needs the `onnx` package, which this image does not ship, so export()
+gates on it the way the reference gates on paddle2onnx.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export needs the 'onnx' package, which is not "
+            "installed in this environment. Use paddle.jit.save(layer, "
+            "path, input_spec=...) for the portable StableHLO export "
+            "instead.") from e
+    raise NotImplementedError(
+        "ONNX graph conversion is not implemented; use paddle.jit.save "
+        "for the StableHLO export.")

@@ -17,15 +17,22 @@ from .program import (  # noqa: F401
     default_startup_program, disable_static, enable_static,
     in_static_mode, load_inference_model, program_guard,
     save_inference_model)
+from .helpers import *  # noqa: F401,F403,E402
+from .helpers import __all__ as _helpers_all
+from ..extension import py_func  # noqa: F401,E402
+from .. import amp  # noqa: F401,E402  (paddle.static.amp surface)
 
 
-class nn:
-    """paddle.static.nn namespace (control-flow surface; reference
-    operators/controlflow/ via fluid/layers/control_flow.py)."""
-    cond = staticmethod(cond)
-    while_loop = staticmethod(while_loop)
-    case = staticmethod(case)
-    switch_case = staticmethod(switch_case)
+def __getattr__(name):
+    # static.nn imports functional layers -> lazy to avoid the
+    # nn-package import cycle at paddle_tpu.static import time
+    if name == "nn":
+        import importlib
+        mod = importlib.import_module(".nn", __name__)
+        globals()["nn"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.static' has no attribute "
+                         f"{name!r}")
 
 
 __all__ = ["InputSpec", "data", "cond", "while_loop", "case",
@@ -33,7 +40,8 @@ __all__ = ["InputSpec", "data", "cond", "while_loop", "case",
            "program_guard", "default_main_program",
            "default_startup_program", "enable_static", "disable_static",
            "in_static_mode", "save_inference_model",
-           "load_inference_model", "InferenceProgram"]
+           "load_inference_model", "InferenceProgram", "py_func",
+           "amp"] + list(_helpers_all)
 
 
 class InputSpec:

@@ -1,0 +1,566 @@
+"""paddle.static parity helpers (reference python/paddle/static/__init__.py
+surface: scopes, places, strategies, program save/load, debug ops).
+
+The reference backs these with the C++ Scope/ParallelExecutor machinery;
+here programs are traced graphs compiled by XLA, so the classes keep the
+API shape while the compiled path does the work (SURVEY.md §7 map).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer_base import ParamAttr
+from .program import (Executor, Program, Variable, default_main_program,
+                      record_gradients)
+
+__all__ = [
+    "Scope", "global_scope", "scope_guard", "device_guard", "name_scope",
+    "cpu_places", "cuda_places", "xpu_places", "tpu_places",
+    "create_parameter", "create_global_var", "Print", "accuracy", "auc",
+    "append_backward", "gradients", "BuildStrategy", "ExecutionStrategy",
+    "CompiledProgram", "ParallelExecutor", "WeightNormParamAttr",
+    "save", "load", "save_vars", "load_vars", "save_to_file",
+    "load_from_file", "set_program_state", "load_program_state",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables",
+]
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+class _ScopeVar:
+    """Variable slot in a Scope (reference framework::Variable): holds a
+    numpy value accessed through get_tensor()."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self
+
+    # tensor-protocol surface used by reference idioms
+    def set(self, value, place=None):
+        self._value = np.asarray(value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype else a
+
+    def shape(self):
+        return tuple(np.asarray(self._value).shape)
+
+
+class Scope:
+    """Hierarchical name->var map (reference framework/scope.h:52)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, _ScopeVar] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = _ScopeVar(name)
+        return self._vars[name]
+
+    def find_var(self, name):
+        if name in self._vars:
+            return self._vars[name]
+        return self._parent.find_var(name) if self._parent else None
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self):
+        return list(self._vars)
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope() -> Scope:
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+# ---------------------------------------------------------------------------
+# places / guards
+# ---------------------------------------------------------------------------
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    if device_count is None:
+        device_count = int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(device_count)]
+
+
+def cuda_places(device_ids=None):
+    """No CUDA on this build — kept for API parity; returns []. Use
+    tpu_places()."""
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def tpu_places(device_ids=None):
+    from ..device import TPUPlace
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return [TPUPlace(d.id) for d in devs]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference fluid/framework.py:5761 device_guard — pins ops to a
+    device in the pipeline pass. The TPU pipeline assigns stages by
+    mesh sharding (distributed/pipeline.py), so this only annotates."""
+    yield
+
+
+_NAME_SCOPE: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference framework.py name_scope: prefixes recorded op names."""
+    _NAME_SCOPE.append(prefix or "scope")
+    try:
+        yield
+    finally:
+        _NAME_SCOPE.pop()
+
+
+def current_name_scope() -> str:
+    return "/".join(_NAME_SCOPE)
+
+
+# ---------------------------------------------------------------------------
+# parameters / vars
+# ---------------------------------------------------------------------------
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference fluid/layers/tensor.py create_parameter: a free-standing
+    trainable Parameter (Xavier init by default, zeros for bias)."""
+    from ..core.dtype import convert_dtype
+    from ..nn import initializer as I
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = (attr.initializer if attr is not None and attr.initializer
+            else default_initializer)
+    if init is None:
+        gw, gb = I.get_global_initializer()
+        init = (gb or I.Constant(0.0)) if is_bias else \
+            (gw or I.XavierUniform())
+    data = init(tuple(int(s) for s in shape), convert_dtype(dtype))
+    p = Parameter(data)
+    if name or (attr is not None and attr.name):
+        p.name = name or attr.name
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference create_global_var: a persistable constant-initialized
+    variable. Non-trainable Tensor here (captured by recorded graphs)."""
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        dtype=convert_dtype(dtype)))
+    t.stop_gradient = True
+    if name:
+        t.name = name
+    return t
+
+
+# ---------------------------------------------------------------------------
+# debug / metrics ops
+# ---------------------------------------------------------------------------
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """reference operators/print_op.cc: pass-through that prints its
+    input. Inside a compiled graph this lowers to jax.debug.print."""
+    import jax
+    from ..core.autograd import apply
+
+    msg = message or ""
+
+    def fn(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply(fn, input, name="print")
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric.metrics import accuracy as _acc
+    return _acc(input, label, k=k, correct=correct, total=total)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference operators/metrics/auc_op.cc): threshold-
+    bucketed trapezoid over the positive-class score input[:, 1]."""
+    import jax.numpy as jnp
+    from ..core.autograd import apply
+
+    def fn(x, lab):
+        pos = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else \
+            x.reshape(x.shape[0], -1)[:, -1]
+        lab = lab.reshape(-1).astype(jnp.float32)
+        idx = jnp.clip((pos * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+        tp = jnp.zeros(num_thresholds + 1).at[idx].add(lab)
+        fp = jnp.zeros(num_thresholds + 1).at[idx].add(1.0 - lab)
+        # cumulative from the highest threshold down
+        tp_c = jnp.cumsum(tp[::-1])
+        fp_c = jnp.cumsum(fp[::-1])
+        tpr = tp_c / jnp.maximum(tp_c[-1], 1.0)
+        fpr = fp_c / jnp.maximum(fp_c[-1], 1.0)
+        return jnp.trapezoid(tpr, fpr).astype(jnp.float32)
+
+    return apply(fn, input, label, name="auc")
+
+
+# ---------------------------------------------------------------------------
+# autodiff
+# ---------------------------------------------------------------------------
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference fluid/backward.py:1337 append_backward — records grad
+    computation for every trainable Parameter feeding `loss`; returns
+    [(param, grad_variable)] pairs."""
+    from .program import _collect
+    if parameter_list is None:
+        _, caps, _ = _collect([loss])
+        parameter_list = [t for t in caps if isinstance(t, Parameter)
+                          and t.trainable]
+    no_grad = no_grad_set or set()
+    parameter_list = [p for p in parameter_list
+                      if getattr(p, "name", None) not in no_grad]
+    if not parameter_list:
+        return []
+    grads = record_gradients([loss], parameter_list,
+                             name="append_backward")
+    return list(zip(parameter_list, grads))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference fluid/backward.py:1932 gradients — d(sum targets)/d
+    inputs; inputs may be graph inputs, intermediates, or Parameters."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "target_gradients (custom output grads) is not supported; "
+            "seed via a weighted sum of targets instead")
+    return record_gradients(targets, inputs, name="gradients")
+
+
+# ---------------------------------------------------------------------------
+# strategies / compiled programs (legacy ParallelExecutor surface)
+# ---------------------------------------------------------------------------
+class BuildStrategy:
+    """reference details/build_strategy.h knob bag. XLA's compile does
+    fusion/memory planning, so the knobs are accepted and recorded; the
+    ones with a TPU equivalent are honored by SpmdTrainer via
+    DistributedStrategy."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.enable_inplace = False
+        self.memory_optimize = None
+        self.sync_batch_norm = False
+        self.remove_unnecessary_lock = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """reference details/execution_strategy.h: scheduler knobs — the XLA
+    step is a single executable, so these only shape the Python loop."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = True
+
+
+class CompiledProgram:
+    """reference compiler.py CompiledProgram: wraps a Program (+build
+    strategy); Executor.run unwraps it. with_data_parallel keeps the
+    chain-call shape — on TPU the dp dimension comes from the mesh
+    (distributed.SpmdTrainer), not from graph cloning."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._places = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._places = places
+        return self
+
+
+class ParallelExecutor:
+    """Legacy reference parallel_executor.cc surface, delegating to the
+    compiled Executor (GSPMD replaces the SSA-graph scheduler)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+        self._loss_name = loss_name
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference fluid/param_attr.py WeightNormParamAttr — marks a
+    parameter for g·v/||v|| reparameterization; layers honor it through
+    nn.utils.weight_norm applied to the owning layer."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         do_model_average=do_model_average,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+# ---------------------------------------------------------------------------
+# program state save/load (reference static/io.py + fluid/io.py)
+# ---------------------------------------------------------------------------
+def _program_params(program) -> List[Parameter]:
+    from .program import _collect
+    seen, out = set(), []
+    roots = []
+    for n in program.nodes:
+        roots.extend(n.outputs)
+    if not roots:
+        return []
+    _, caps, _ = _collect(roots)
+    for t in caps:
+        if isinstance(t, Parameter) and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+    return out
+
+
+def _state_of(program) -> Dict[str, np.ndarray]:
+    return {p.name: np.asarray(p.data) for p in _program_params(program)}
+
+
+def save(program, model_path, protocol=4):
+    """reference paddle.static.save: persist program parameters to
+    `model_path + '.pdparams'` through the pluggable fs backend."""
+    from ..framework.fs import open_for_write
+    state = _state_of(program)
+    with open_for_write(model_path + ".pdparams") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference paddle.static.load: restore parameters saved by save."""
+    state = load_program_state(model_path, var_list=var_list)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.fs import open_for_read
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    with open_for_read(path) as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        names = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    params = {p.name: p for p in _program_params(program)}
+    missing = sorted(set(state_dict) - set(params))
+    for name, p in params.items():
+        if name in state_dict:
+            a = np.asarray(state_dict[name])
+            if tuple(a.shape) != tuple(p.data.shape):
+                raise ValueError(
+                    f"set_program_state: shape mismatch for {name}: "
+                    f"{a.shape} vs {tuple(p.data.shape)}")
+            p._data = jnp.asarray(a, dtype=p.data.dtype)
+    if missing:
+        import warnings
+        warnings.warn(f"set_program_state: {len(missing)} entries had no "
+                      f"matching parameter: {missing[:5]}...")
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference fluid/io.py save_vars: one file per var (or a combined
+    `filename`)."""
+    program = main_program or default_main_program()
+    ps = _program_params(program)
+    if vars is not None:
+        names = {getattr(v, "name", v) for v in vars}
+        ps = [p for p in ps if p.name in names]
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    from ..framework.fs import open_for_write, get_fs
+    get_fs(dirname).makedirs(dirname)
+    if filename:
+        with open_for_write(os.path.join(dirname, filename)) as f:
+            pickle.dump({p.name: np.asarray(p.data) for p in ps}, f)
+    else:
+        for p in ps:
+            with open_for_write(os.path.join(dirname, p.name)) as f:
+                pickle.dump(np.asarray(p.data), f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    ps = _program_params(program)
+    if vars is not None:
+        names = {getattr(v, "name", v) for v in vars}
+        ps = [p for p in ps if p.name in names]
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    from ..framework.fs import open_for_read
+    if filename:
+        with open_for_read(os.path.join(dirname, filename)) as f:
+            state = pickle.load(f)
+        for p in ps:
+            if p.name in state:
+                p._data = jnp.asarray(state[p.name], dtype=p.data.dtype)
+    else:
+        for p in ps:
+            with open_for_read(os.path.join(dirname, p.name)) as f:
+                p._data = jnp.asarray(pickle.load(f),
+                                      dtype=p.data.dtype)
+
+
+def save_to_file(path, content: bytes):
+    from ..framework.fs import open_for_write
+    with open_for_write(path) as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    from ..framework.fs import open_for_read
+    with open_for_read(path) as f:
+        return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars) -> bytes:
+    """reference static/io.py serialize_persistables: parameters of the
+    program feeding fetch_vars, pickled."""
+    from .program import _collect
+    fetch_vars = [fetch_vars] if isinstance(fetch_vars, Variable) \
+        else list(fetch_vars)
+    _, caps, _ = _collect(fetch_vars)
+    state = {t.name: np.asarray(t.data) for t in caps
+             if isinstance(t, Parameter)}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def serialize_program(feed_vars, fetch_vars) -> bytes:
+    """reference static/io.py serialize_program. The portable compiled
+    form of a traced program is its StableHLO export — the same artifact
+    save_inference_model writes (jit/api.py)."""
+    import tempfile
+    from .program import save_inference_model as _sim
+    feed_vars = [feed_vars] if isinstance(feed_vars, Variable) \
+        else list(feed_vars)
+    fetch_vars = [fetch_vars] if isinstance(fetch_vars, Variable) \
+        else list(fetch_vars)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "prog")
+        _sim(prefix, feed_vars, fetch_vars)
+        payload = {}
+        for fn in sorted(os.listdir(d)):
+            with open(os.path.join(d, fn), "rb") as f:
+                payload[fn] = f.read()
+    return pickle.dumps(payload)
+
+
+def deserialize_program(data: bytes):
+    """Inverse of serialize_program: returns an InferenceProgram
+    Executor.run can execute."""
+    import tempfile
+    from .program import load_inference_model as _lim
+    payload = pickle.loads(data)
+    with tempfile.TemporaryDirectory() as d:
+        for fn, blob in payload.items():
+            with open(os.path.join(d, fn), "wb") as f:
+                f.write(blob)
+        prefix = os.path.join(d, "prog")
+        prog, _, _ = _lim(prefix)
+        return prog

@@ -1,0 +1,545 @@
+"""paddle.static.nn — static-graph layer helpers (reference
+python/paddle/static/nn/__init__.py, impls in fluid/layers/nn.py).
+
+The reference helpers append ops + parameters to the default Program via
+LayerHelper; here each call creates its Parameters eagerly (they are
+captured by the recorded graph) and applies the functional op, which
+records onto the Program in static mode. Same one-call-one-layer
+contract as the reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import ParamAttr
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from .helpers import create_parameter  # noqa: F401
+from ..extension import py_func  # noqa: F401
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "create_parameter", "crf_decoding", "data_norm", "deform_conv2d",
+    "group_norm", "instance_norm", "layer_norm", "multi_box_head", "nce",
+    "prelu", "py_func", "row_conv", "spectral_norm", "switch_case",
+    "while_loop", "sparse_embedding",
+]
+
+
+def _shape(x):
+    if isinstance(x, Tensor):
+        return tuple(x.data.shape)
+    return tuple(x.shape)
+
+
+def _dtype(x):
+    if isinstance(x, Tensor):
+        return x.data.dtype
+    return x.dtype
+
+
+def _make_param(shape, dtype, attr, is_bias=False, default_init=None):
+    # single param factory — helpers.create_parameter owns the
+    # attr -> initializer -> Parameter logic
+    return create_parameter(shape, dtype, attr=attr, is_bias=is_bias,
+                            default_initializer=default_init)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference fluid/layers/nn.py fc: flatten trailing dims, matmul,
+    bias, optional activation. Accepts a list of inputs (summed)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = None
+    for xi in xs:
+        shp = _shape(xi)
+        in_f = int(np.prod(shp[num_flatten_dims:]))
+        w = _make_param([in_f, size], _dtype(xi), weight_attr)
+        flat = F.linear(
+            xi.reshape((*shp[:num_flatten_dims], in_f))
+            if len(shp) != 2 or num_flatten_dims != 1 else xi, w)
+        out = flat if out is None else out + flat
+    b = _make_param([size], _dtype(xs[0]), bias_attr, is_bias=True)
+    if b is not None:
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """reference fluid/input.py embedding (lookup_table_v2)."""
+    w = _make_param(list(size), dtype, param_attr,
+                    default_init=I.Normal(0.0, 1.0 / math.sqrt(size[1])))
+    return F.embedding(input, w, padding_idx=padding_idx,
+                       sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32"):
+    """reference fluid/contrib sparse_embedding: the PS large-vocab
+    table; here = embedding with the SelectedRows sparse-grad path."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def _conv_nd(x, num_filters, filter_size, nd, stride, padding, dilation,
+             groups, param_attr, bias_attr, act, transpose=False,
+             output_size=None):
+    shp = _shape(x)
+    cin = shp[1]
+    ks = [filter_size] * nd if isinstance(filter_size, int) \
+        else list(filter_size)
+    if transpose:
+        wshape = [cin, num_filters // (groups or 1)] + ks
+    else:
+        wshape = [num_filters, cin // (groups or 1)] + ks
+    fan_in = (cin // (groups or 1)) * int(np.prod(ks))
+    bound = math.sqrt(1.0 / max(fan_in, 1))
+    w = _make_param(wshape, _dtype(x), param_attr,
+                    default_init=I.Uniform(-bound, bound))
+    b = _make_param([num_filters], _dtype(x), bias_attr, is_bias=True)
+    if transpose:
+        fn = {2: F.conv2d_transpose, 3: F.conv3d_transpose}[nd]
+        out = fn(x, w, b, stride=stride, padding=padding,
+                 groups=groups or 1, output_size=output_size)
+    else:
+        fn = {2: F.conv2d, 3: F.conv3d}[nd]
+        out = fn(x, w, b, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups or 1)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None,
+           data_format="NCHW"):
+    return _conv_nd(input, num_filters, filter_size, 2, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    return _conv_nd(input, num_filters, filter_size, 3, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=None, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    if filter_size is None:
+        raise ValueError("conv2d_transpose: filter_size is required "
+                         "(output_size-only inference not supported)")
+    return _conv_nd(input, num_filters, filter_size, 2, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    transpose=True, output_size=output_size)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=None, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    if filter_size is None:
+        raise ValueError("conv3d_transpose: filter_size is required")
+    return _conv_nd(input, num_filters, filter_size, 3, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    transpose=True, output_size=output_size)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """reference batch_norm_op.cc. Static-mode note: the recorded graph
+    captures the moving statistics as constants; training-mode batch
+    statistics are used when is_test=False."""
+    C = _shape(input)[1]
+    dt = _dtype(input)
+    w = _make_param([C], dt, param_attr, default_init=I.Constant(1.0))
+    b = _make_param([C], dt, bias_attr, is_bias=True)
+    training = not (is_test or use_global_stats)
+    rm = Tensor(jnp.zeros((C,), dt))
+    rv = Tensor(jnp.ones((C,), dt))
+
+    # routed through apply (not F.batch_norm) so static mode records it;
+    # a recorded graph captures the moving stats as constants.
+    # attr=False params run as affine identity (reference allows it)
+    def fn(a, ww, bb, mm, vv):
+        ax = (1, -1) + (1,) * (a.ndim - 2)
+        if training:
+            red = (0,) + tuple(range(2, a.ndim))
+            mu = a.mean(axis=red)
+            var = ((a - mu.reshape(ax)) ** 2).mean(axis=red)
+        else:
+            mu, var = mm, vv
+        out = (a - mu.reshape(ax)) * jax.lax.rsqrt(
+            var.reshape(ax) + epsilon)
+        return out * ww.reshape(ax) + bb.reshape(ax)
+
+    out = apply(fn, input,
+                w if w is not None else Tensor(jnp.ones((C,), dt)),
+                b if b is not None else Tensor(jnp.zeros((C,), dt)),
+                rm, rv, name="batch_norm")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shp = _shape(input)
+    norm_shape = shp[begin_norm_axis:]
+    dt = _dtype(input)
+    n = int(np.prod(norm_shape))
+    w = _make_param([n], dt, param_attr,
+                    default_init=I.Constant(1.0)) if scale else None
+    b = _make_param([n], dt, bias_attr, is_bias=True) if shift else None
+
+    def fn(a, *wb):
+        # unpack by which params actually exist (attr=False drops one)
+        have_w = w is not None
+        have_b = b is not None
+        ww = wb[0] if have_w else None
+        bb = wb[1 if have_w else 0] if have_b else None
+        ax = tuple(range(begin_norm_axis, a.ndim))
+        mu = a.mean(axis=ax, keepdims=True)
+        var = ((a - mu) ** 2).mean(axis=ax, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + epsilon)
+        tail = a.shape[begin_norm_axis:]
+        if ww is not None:
+            out = out * ww.reshape(tail)
+        if bb is not None:
+            out = out + bb.reshape(tail)
+        return out
+
+    args = [a for a in (w, b) if a is not None]
+    out = apply(fn, input, *args, name="layer_norm")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    C = _shape(input)[1]
+    dt = _dtype(input)
+    w = _make_param([C], dt, param_attr, default_init=I.Constant(1.0))
+    b = _make_param([C], dt, bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    C = _shape(input)[1]
+    dt = _dtype(input)
+    w = _make_param([C], dt, param_attr, default_init=I.Constant(1.0))
+    b = _make_param([C], dt, bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_0=0.9999999):
+    """reference data_norm_op.cc (CTR feature normalization): normalize
+    by accumulated batch_sum / batch_size statistics, which train as
+    parameters (no beta/gamma)."""
+    C = _shape(input)[-1]
+    dt = _dtype(input)
+    batch_size = _make_param([C], dt, param_attr,
+                             default_init=I.Constant(1e4))
+    batch_sum = _make_param([C], dt, param_attr,
+                            default_init=I.Constant(0.0))
+    batch_square = _make_param([C], dt, param_attr,
+                               default_init=I.Constant(1e4))
+    if batch_size is None:  # attr=False: fixed identity statistics
+        batch_size = Tensor(jnp.full((C,), 1e4, dt))
+        batch_sum = Tensor(jnp.zeros((C,), dt))
+        batch_square = Tensor(jnp.full((C,), 1e4, dt))
+
+    def fn(a, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq, epsilon))
+        out = (a - mean) * scale
+        return out
+
+    out = apply(fn, input, batch_size, batch_sum, batch_square,
+                name="data_norm")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """reference prelu_op.cc: mode all (one alpha) / channel / element."""
+    shp = _shape(x)
+    dt = _dtype(x)
+    if mode == "all":
+        ashape = [1]
+    elif mode == "channel":
+        ashape = [shp[1]]
+    elif mode == "element":
+        ashape = list(shp[1:])
+    else:
+        raise ValueError(f"prelu mode {mode!r}")
+    alpha = _make_param(ashape, dt, param_attr,
+                        default_init=I.Constant(0.25))
+    if alpha is None:  # attr=False: the reference's default slope
+        alpha = Tensor(jnp.full(ashape, 0.25, dt))
+
+    def fn(a, al):
+        if mode == "channel":
+            al = al.reshape((1, -1) + (1,) * (a.ndim - 2))
+        elif mode == "element":
+            al = al.reshape((1,) + a.shape[1:])
+        return jnp.where(a > 0, a, al * a)
+
+    return apply(fn, x, alpha, name="prelu")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference row_conv_op.cc (lookahead conv for streaming ASR):
+    out[t] = sum_{i=0..k} in[t+i] * w[i] over [B, T, D]."""
+    D = _shape(input)[-1]
+    k = future_context_size
+    w = _make_param([k + 1, D], _dtype(input), param_attr)
+    if w is None:
+        raise ValueError("row_conv requires a weight parameter "
+                         "(param_attr must not be False)")
+
+    def fn(a, ww):
+        pads = [(0, 0)] * a.ndim
+        pads[-2] = (0, k)
+        ap = jnp.pad(a, pads)
+        T = a.shape[-2]
+        out = 0.0
+        for i in range(k + 1):
+            out = out + ap[..., i:i + T, :] * ww[i]
+        return out
+
+    out = apply(fn, input, w, name="row_conv")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference spectral_norm_op.cc: w / sigma_max(w) estimated by
+    `power_iters` rounds of power iteration from fixed unit vectors
+    (deterministic under jit, like the persisted u/v of the reference)."""
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0)
+        h = wm.shape[0]
+        mat = wm.reshape(h, -1).astype(jnp.float32)
+        u = jnp.ones((h,), jnp.float32) / math.sqrt(h)
+        v = None
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        return (w / sigma.astype(w.dtype))
+
+    return apply(fn, weight, name="spectral_norm")
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference bilinear_tensor_product_op.cc: out_k = x^T W_k y + b."""
+    dx = _shape(x)[-1]
+    dy = _shape(y)[-1]
+    dt = _dtype(x)
+    w = _make_param([size, dx, dy], dt, param_attr)
+    if w is None:
+        raise ValueError("bilinear_tensor_product requires a weight "
+                         "parameter (param_attr must not be False)")
+    b = _make_param([1, size], dt, bias_attr, is_bias=True)
+
+    def fn(xa, ya, wa, *rest):
+        out = jnp.einsum("bi,kij,bj->bk", xa, wa, ya)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [x, y, w] + ([b] if b is not None else [])
+    out = apply(fn, *args, name="bilinear_tensor_product")
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce_op.cc), uniform
+    negative sampling. Returns per-sample loss [N, 1]."""
+    D = _shape(input)[-1]
+    dt = _dtype(input)
+    w = _make_param([num_total_classes, D], dt, param_attr)
+    if w is None:
+        raise ValueError("nce requires a weight parameter "
+                         "(param_attr must not be False)")
+    b = _make_param([num_total_classes], dt, bias_attr, is_bias=True)
+    k = num_neg_samples
+
+    def fn(xa, lab, wa, ba):
+        N = xa.shape[0]
+        lab = lab.reshape(-1).astype(jnp.int32)
+        key = jax.random.PRNGKey(seed)
+        neg = jax.random.randint(key, (N, k), 0, num_total_classes)
+        pos_logit = jnp.einsum("nd,nd->n", xa, wa[lab]) + ba[lab]
+        neg_logit = jnp.einsum("nd,nkd->nk", xa, wa[neg]) + ba[neg]
+        # NCE with uniform noise: P_noise = 1/V; logit shift log(k*Pn)
+        shift = jnp.log(jnp.float32(k) / num_total_classes)
+        pos = jax.nn.softplus(-(pos_logit - shift))
+        negs = jax.nn.softplus(neg_logit - shift).sum(axis=1)
+        return (pos + negs).reshape(-1, 1)
+
+    return apply(fn, input, label, w,
+                 b if b is not None else
+                 Tensor(jnp.zeros((num_total_classes,), dt)),
+                 name="nce")
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """reference crf_decoding_op.cc: viterbi best path. `transition`
+    may be passed directly (the linear_chain_crf parameter, including
+    the reference's start/stop rows at [0]/[1]); otherwise one is
+    created. With `label`, returns the per-step correctness mask like
+    the reference."""
+    from ..text.decoding import viterbi_decode
+    T = _shape(input)[-1]
+    if transition is None:
+        transition = _make_param([T + 2, T], _dtype(input), param_attr)
+
+    # strip the start/stop rows the linear_chain_crf parameter carries
+    trans_body = apply(lambda t: t[2:], transition, name="crf_trans")
+    _, path = viterbi_decode(input, trans_body,
+                             lengths=length, include_bos_eos_tag=False)
+    if label is not None:
+        eq = apply(lambda a, b: (a == b.reshape(a.shape)).astype(
+            jnp.int64), path, label, name="crf_correct")
+        return eq
+    return path
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2),
+                   flip=True, clip=False, kernel_size=1, pad=0,
+                   stride=1, name=None, min_max_aspect_ratios_order=False):
+    """SSD detection head (reference detection/multi_box_head in
+    fluid/layers/detection.py): per-feature-map conv predictors for
+    location + confidence, plus prior boxes. Returns
+    (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio schedule
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int(math.floor((max_ratio - min_ratio) /
+                              max(n_maps - 2, 1)))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_maps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_maps - 1]
+
+    locs, confs, priors, pvars = [], [], [], []
+    img_h = _shape(image)[2]
+    img_w = _shape(image)[3]
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        mn = min_sizes[i] if not isinstance(min_sizes[i],
+                                            (list, tuple)) \
+            else min_sizes[i]
+        mn_list = [mn] if not isinstance(mn, (list, tuple)) else list(mn)
+        mx = None
+        if max_sizes is not None:
+            mx = max_sizes[i]
+            mx = [mx] if not isinstance(mx, (list, tuple)) else list(mx)
+        fh, fw = _shape(feat)[2], _shape(feat)[3]
+        from ..vision.ops import prior_box as _prior
+        # explicit strides (standard SSD configs) override the
+        # image/feature ratio; step_w/step_h pin both axes the same way
+        step_i = None
+        if steps is not None:
+            step_i = steps[i] if isinstance(steps, (list, tuple)) \
+                else steps
+        elif step_w is not None or step_h is not None:
+            step_i = step_w if step_w is not None else step_h
+        boxes = _prior(fh, fw, img_h, img_w, mn_list,
+                       max_sizes=mx or (), aspect_ratios=ar, flip=flip,
+                       clip=clip, offset=offset, step=step_i)
+        n_priors_per_cell = boxes.shape[2]
+        boxes = boxes.reshape([-1, 4])
+        priors.append(boxes)
+        pvars.append(Tensor(jnp.tile(
+            jnp.asarray(variance, jnp.float32)[None, :],
+            (boxes.shape[0], 1))))
+        loc = conv2d(feat, n_priors_per_cell * 4, kernel_size,
+                     stride=stride, padding=pad)
+        conf = conv2d(feat, n_priors_per_cell * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+
+        def nchw_to_flat(t, last):
+            n = _shape(t)[0]
+            return apply(
+                lambda a: jnp.moveaxis(a, 1, -1).reshape(
+                    a.shape[0], -1, last), t, name="transpose_flatten")
+
+        locs.append(nchw_to_flat(loc, 4))
+        confs.append(nchw_to_flat(conf, num_classes))
+
+    from ..tensor import concat
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(priors, axis=0), concat(pvars, axis=0))
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, modulated=True, name=None):
+    """static.nn.deform_conv2d (reference static/nn/common.py): creates
+    the filter parameter and applies the deformable conv op."""
+    from ..vision.ops import deform_conv2d as _dcn
+    cin = _shape(input)[1]
+    ks = [filter_size] * 2 if isinstance(filter_size, int) \
+        else list(filter_size)
+    fan_in = (cin // groups) * int(np.prod(ks))
+    bound = math.sqrt(1.0 / max(fan_in, 1))
+    w = _make_param([num_filters, cin // groups] + ks, _dtype(input),
+                    param_attr, default_init=I.Uniform(-bound, bound))
+    b = _make_param([num_filters], _dtype(input), bias_attr,
+                    is_bias=True)
+    return _dcn(input, offset, w, bias=b, stride=stride, padding=padding,
+                dilation=dilation, deformable_groups=deformable_groups,
+                groups=groups, mask=mask if modulated else None)

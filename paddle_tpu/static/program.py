@@ -439,6 +439,99 @@ class Executor:
         return run_train
 
 
+def record_gradients(targets, wrt, name="gradients"):
+    """Record a node computing d(sum(targets))/d(wrt) into the program
+    (reference backward.py gradients / append_backward grad-op chains —
+    here one node whose fn is jax.grad over the re-run subgraph).
+
+    `wrt` entries may be graph Variables (inputs OR intermediates: the
+    dependency is cut at that variable, matching grad-op semantics) or
+    captured Tensors/Parameters. Returns one grad Variable per entry.
+    """
+    from ..core.tensor import Tensor
+
+    targets = [targets] if isinstance(targets, Variable) else list(targets)
+    wrt = [wrt] if isinstance(wrt, (Variable, Tensor)) else list(wrt)
+    nodes, caps, input_vars = _collect(targets)
+
+    wrt_vars = [w for w in wrt if isinstance(w, Variable)]
+    wrt_caps = [w for w in wrt if not isinstance(w, Variable)]
+    cap_pos = {id(c): i for i, c in enumerate(caps)}
+    for w in wrt_caps:
+        if id(w) not in cap_pos:
+            raise ValueError(
+                f"gradients: tensor {getattr(w, 'name', w)} does not "
+                f"feed the target(s)")
+
+    n_in, n_cap, n_var = len(input_vars), len(caps), len(wrt_vars)
+
+    def grad_fn(*vals):
+        feeds = list(vals[:n_in])
+        capvals = list(vals[n_in:n_in + n_cap])
+        var_overrides = list(vals[n_in + n_cap:])
+
+        def run_with(leaves):
+            ov_caps = leaves[:len(wrt_caps)]
+            ov_vars = leaves[len(wrt_caps):]
+            ca = list(capvals)
+            for w, v in zip(wrt_caps, ov_caps):
+                ca[cap_pos[id(w)]] = v
+            env = {id(v): a for v, a in zip(input_vars, feeds)}
+            # seed the cut points FIRST: a node output already in env is
+            # never overwritten, so the dependency stops here
+            for w, v in zip(wrt_vars, ov_vars):
+                env[id(w)] = v
+            cap_env = {id(t): a for t, a in zip(caps, ca)}
+            for n in nodes:
+                if all(id(o) in env for o in n.outputs):
+                    continue
+                ins = [env[id(a)] if isinstance(a, Variable)
+                       else cap_env[id(a)] if isinstance(a, Tensor)
+                       else a for a in n.inputs]
+                out = n.fn(*ins)
+                outs = tuple(out) if n.multi else (out,)
+                for v, o in zip(n.outputs, outs):
+                    env.setdefault(id(v), o)
+            total = 0.0
+            for t in targets:
+                total = total + env[id(t)].astype(jnp.float32).sum()
+            return total
+
+        leaves0 = [capvals[cap_pos[id(w)]] for w in wrt_caps] + \
+            var_overrides
+        grads = jax.grad(run_with)(leaves0)
+        return tuple(g.astype(l.dtype) for g, l in zip(grads, leaves0))
+
+    node = _Node(grad_fn, list(input_vars) + list(caps) + wrt_vars,
+                 name, multi=True)
+    prog = default_main_program()
+    out_vars = []
+    for i, w in enumerate(wrt):
+        if isinstance(w, Variable):
+            shape, dtype = w.shape, w.dtype
+        else:
+            shape, dtype = tuple(w.data.shape), w.data.dtype
+        out_vars.append(Variable(shape, dtype, producer=node, out_index=i,
+                                 program=prog,
+                                 name=f"{getattr(w, 'name', 'x')}@GRAD"))
+    # grad order follows leaves0 = caps-first then vars; remap to wrt's
+    # order at output-index level
+    order = []
+    ci = vi = 0
+    for w in wrt:
+        if isinstance(w, Variable):
+            order.append(len(wrt_caps) + vi)
+            vi += 1
+        else:
+            order.append(ci)
+            ci += 1
+    for v, idx in zip(out_vars, order):
+        v.out_index = idx
+    node.outputs = sorted(out_vars, key=lambda v: v.out_index)
+    prog._add_node(node)
+    return out_vars
+
+
 def install_minimize(program: Program, loss: Variable, optimizer):
     """Optimizer.minimize(symbolic loss) lands here: record the training
     hook (reference: minimize appended backward + optimizer ops)."""

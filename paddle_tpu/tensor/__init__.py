@@ -10,5 +10,6 @@ from .random import *  # noqa: F401,F403
 from .attribute import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .sequence import *  # noqa: F401,F403
+from .array import *  # noqa: F401,F403
 
 from ..core.tensor import Tensor, to_tensor, is_tensor  # noqa: F401

@@ -1,0 +1,70 @@
+"""TensorArray ops (reference: LoDTensorArray + python array_write /
+array_read / array_length / create_array in fluid/layers/tensor.py and
+lod_array_length_op.cc / array_read_op / array_write_op).
+
+The reference backs these with a C++ vector<LoDTensor> variable used by
+While loops and dynamic RNN/beam-search. Eagerly a plain Python list is
+the same thing; inside a traced/compiled region, fixed-trip loops over
+stacked tensors (lax.scan in static/control_flow.py) replace the
+dynamic array — these ops are the eager/imperative surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length"]
+
+
+class TensorArray(list):
+    """A list of Tensors (the LoDTensorArray role)."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self.dtype = dtype
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = TensorArray(dtype)
+    for v in (initialized_list or ()):
+        arr.append(v if isinstance(v, Tensor) else to_tensor(v))
+    return arr
+
+
+def _idx(i):
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.data))
+    return int(i)
+
+
+def array_write(x, i, array=None):
+    """Write x at index i, growing the array as needed; returns the
+    array (reference array_write_op semantics: i may extend the array
+    by exactly one slot)."""
+    if array is None:
+        array = create_array(getattr(x, "dtype", "float32"))
+    i = _idx(i)
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {i} skips slots (len={len(array)})")
+    return array
+
+
+def array_read(array, i):
+    i = _idx(i)
+    if not 0 <= i < len(array):
+        raise IndexError(f"array_read index {i} out of range "
+                         f"(len={len(array)})")
+    return array[i]
+
+
+def array_length(array):
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(len(array), dtype=jnp.int64))

@@ -45,3 +45,22 @@ def conj(x, name=None):
 
 def angle(x, name=None):
     return apply(jnp.angle, x, name="angle")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure Tensor repr formatting (reference
+    python/paddle/tensor/to_string.py set_printoptions). Tensor.__repr__
+    prints through numpy, so this maps onto numpy's print options."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)

@@ -221,3 +221,20 @@ def householder_product(x, tau, name=None):
         return out.reshape(batch + out.shape[-2:])
 
     return apply(_hp, x, tau, name="householder_product")
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) with broadcasting (reference dist_op.cc).
+    p=0 counts non-zero entries; p=inf/-inf are max/min |diff|."""
+    pf = float(p)
+
+    def fn(a, b):
+        d = (a - b).astype(jnp.float32)
+        if pf == 0:
+            return jnp.sum((d != 0).astype(jnp.float32))
+        if jnp.isinf(pf):
+            m = jnp.abs(d)
+            return jnp.max(m) if pf > 0 else jnp.min(m)
+        return jnp.sum(jnp.abs(d) ** pf) ** (1.0 / pf)
+
+    return apply(fn, x, y, name="dist")

@@ -505,3 +505,20 @@ def crop(x, shape=None, offsets=None, name=None):
                              [o + s for o, s in zip(offs, sizes)])
 
     return apply(fn, x, name="crop")
+
+
+def squeeze_(x, axis=None, name=None):
+    """In-place squeeze (reference squeeze_ / Squeeze2 inplace kernel)."""
+    x._data = squeeze(x, axis=axis).data
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    """In-place unsqueeze (reference unsqueeze_)."""
+    x._data = unsqueeze(x, axis).data
+    return x
+
+
+# reference paddle 2.0 exports the op under both names
+# (crop_tensor_op.cc; python crop_tensor / crop)
+crop_tensor = crop

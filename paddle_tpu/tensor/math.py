@@ -508,3 +508,20 @@ def renorm(x, p, axis, max_norm, name=None):
         return (a * scale.astype(a.dtype))
 
     return apply(fn, x, name="renorm")
+
+
+def increment(x, value=1.0, name=None):
+    """Add `value` to the single-element tensor x in place and return it
+    (reference increment_op.cc — the loop-counter op; works on any
+    1-element tensor)."""
+    if int(np.prod(x.shape)) != 1:
+        raise ValueError(
+            f"increment expects a 1-element tensor, got shape {x.shape}")
+    x._data = x.data + jnp.asarray(value, dtype=x.data.dtype)
+    return x
+
+
+def tanh_(x, name=None):
+    """In-place tanh (reference tanh_ inplace activation)."""
+    x._data = jnp.tanh(x.data)
+    return x

