@@ -42,6 +42,11 @@ def peak_flops(device) -> float:
     return 0.0
 
 
+def _flash_blocks(seq, head_dim, causal=True):
+    from paddle_tpu.ops import get_block_sizes
+    return get_block_sizes(seq, head_dim, causal)
+
+
 def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
                 remat=None):
     import numpy as np
@@ -54,10 +59,16 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     from dataclasses import replace
     import jax
 
+    # blocked cross-entropy (no [B,S,V] logits) and scan-over-layers
+    # (O(1) traced transformer bodies) are ON by default; env
+    # kill-switches for A/B
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "1") != "0"
+    scan_layers = os.environ.get("BENCH_SCAN_LAYERS", "1") != "0"
     cfg = replace(gpt_configs()[config_name], max_seq_len=seq,
-                  use_flash_attention=use_flash)
+                  use_flash_attention=use_flash, fused_ce=fused_ce)
     log(f"bench: {config_name} seq={seq} batch={batch} "
-        f"flash={use_flash} ({cfg.num_params()/1e6:.0f}M params)")
+        f"flash={use_flash} fused_ce={fused_ce} scan={scan_layers} "
+        f"({cfg.num_params()/1e6:.0f}M params)")
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -77,7 +88,8 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     st.recompute = remat               # remat blocks, selective policy:
     # save matmul outputs ('dots'), recompute only cheap elementwise ops —
     # full remat pays the whole forward twice and caps MFU ~2/3
-    st.recompute_configs = {"policy": "dots_no_batch"}
+    st.recompute_configs = {"policy": "dots_no_batch",
+                            "scan_layers": scan_layers}
     mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh,
                           strategy=st)
@@ -132,6 +144,11 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
         "loss": float(loss),
         "use_flash": use_flash,
         "flash_kernel_in_step": flash_in_step,
+        "fused_ce": fused_ce,
+        "scan_layers": scan_layers,
+        # the autotuned tiles this step's flash kernel ran with
+        "flash_blocks": list(_flash_blocks(
+            seq, cfg.hidden_size // cfg.num_heads)) if use_flash else None,
         "remat": remat,
         "remat_policy": "dots_no_batch" if remat else "off",
         "platform": jax.devices()[0].platform,
@@ -213,7 +230,8 @@ def bench_flash(seqs=(1024, 2048, 4096), batch=8):
 
         comp_ms = run(lambda a, b, c: _sdpa_reference(
             a, b, c, is_causal=True))
-        row = {"seq": s, "composite_ms": round(comp_ms, 2)}
+        row = {"seq": s, "composite_ms": round(comp_ms, 2),
+               "flash_blocks": list(_flash_blocks(s, 64))}
         if _ops.flash_attention_available():
             flash_ms = run(lambda a, b, c: _ops.flash_attention(
                 a, b, c, causal=True))

@@ -21,8 +21,41 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "create_mesh",
-           "get_mesh", "set_mesh", "mesh_axis_size", "default_mesh"]
+# shard_map moved across jax versions (jax.experimental.shard_map ->
+# top-level jax.shard_map) and renamed its replication-check kwarg
+# (check_rep -> check_vma); resolve once here so every consumer gets a
+# callable with the NEW spelling regardless of the installed version.
+try:
+    from jax import shard_map as _sm
+    _shard_map = _sm if callable(_sm) else _sm.shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    import functools as _functools
+
+    @_functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis inside shard_map/pmap bodies.
+    jax.lax.axis_size only exists from jax 0.5; psum of a Python
+    constant is the portable spelling (folded to a static int)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "axis_size",
+           "create_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
+           "default_mesh", "shard_map"]
 
 _current_mesh: Optional[Mesh] = None
 

@@ -44,6 +44,7 @@ from ..core.tensor import Tensor
 from ..nn import initializer as I
 from ..nn.layer_base import Layer, ParamAttr
 from .mesh import PartitionSpec, get_mesh, NamedSharding
+from .mesh import axis_size as _axis_size
 from .parallel_layers import mark_sharding, _in_shard_map
 
 __all__ = ["MoELayer", "ExpertParallelFFN", "top_k_gating",
@@ -245,7 +246,7 @@ class MoELayer(Layer):
     # -- explicit all_to_all formulation (inside shard_map, dp==ep) --
     def _fn_shard_map(self, x, gate, w_up, b_up, w_down, b_down):
         axis = self.ep_axis
-        world = jax.lax.axis_size(axis)
+        world = _axis_size(axis)
         b, s, h = x.shape                       # local batch shard
         e_loc = w_up.shape[0]                   # local experts
         n_exp = e_loc * world

@@ -41,13 +41,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 
 from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
 from .fleet.strategy import DistributedStrategy
-from .mesh import Mesh, NamedSharding, PartitionSpec
+from .mesh import Mesh, NamedSharding, PartitionSpec, shard_map
 
 __all__ = ["GPipeTrainer", "stack_block_params"]
 

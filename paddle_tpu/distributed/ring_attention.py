@@ -35,11 +35,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-
 from ..core.autograd import apply
 from ..core.tensor import Tensor
-from .mesh import Mesh, PartitionSpec, get_mesh
+from .mesh import Mesh, PartitionSpec, get_mesh, shard_map
+from .mesh import axis_size as _axis_size
 
 __all__ = ["ring_attention", "ring_attention_local",
            "sequence_parallel_attention"]
@@ -56,7 +55,7 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
     fewer heads [B, T, Hkv, D] (GQA) — the UN-expanded blocks are what
     rotate, so grouped-query models pay Hkv/H of the MHA ring traffic.
     Returns the local output shard [B, T, H, D]."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
     hkv = k.shape[2]

@@ -200,6 +200,20 @@ class SpmdTrainer:
             else:
                 model.enable_recompute()
 
+        # scan-over-layers (recompute_configs={'scan_layers': True}):
+        # the model runs its homogeneous block stack as one lax.scan so
+        # XLA traces/compiles the body once instead of once per layer;
+        # combined with recompute, jax.checkpoint applies per scan
+        # iteration (= per block). Independent of strategy.recompute —
+        # the compile-time win stands on its own.
+        if st.recompute_configs.get("scan_layers"):
+            if not hasattr(model, "enable_scan_layers"):
+                raise NotImplementedError(
+                    "recompute_configs['scan_layers']=True but the model "
+                    "has no enable_scan_layers(); only models with a "
+                    "homogeneous block stack (GPT) support scanning")
+            model.enable_scan_layers(True)
+
         # ---- state pytrees (raw arrays keyed by structured name) --------
         self._param_objs = dict(model.named_parameters())
         # name-based decay hooks (AdamW apply_decay_param_fun, Lamb

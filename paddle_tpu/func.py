@@ -73,7 +73,13 @@ def functional_call(layer: Layer, params: Dict[str, Any],
             out = layer(*wrapped_args, **kwargs)
             new_buffers = {name: t.data for name, t in buf_tensors.items()
                            if t is not None and name in (buffers or {})}
-        return _unwrap(out), new_buffers
+            # unwrap INSIDE the swap: a forward may return a parameter
+            # object itself (e.g. the tied LM-head weight for the fused
+            # loss); reading .data after restore would silently swap the
+            # traced value for the stale concrete array and drop its
+            # gradient
+            out = _unwrap(out)
+        return out, new_buffers
     finally:
         if training is not None:
             layer.train() if prev_mode else layer.eval()

@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -63,6 +64,11 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_flash_attention: bool = True
     tie_word_embeddings: bool = True
+    # fused LM loss: during training the model returns (hidden, wte) so
+    # the criterion can run the blocked cross-entropy over vocab chunks
+    # (ops.fused_cross_entropy) — the [B, S, V] logits tensor is never
+    # materialized. Requires tie_word_embeddings.
+    fused_ce: bool = False
     tp_axis: str = "tp"
     # MoE (0 experts = dense; BASELINE.json config #5 switch-transformer)
     moe_num_experts: int = 0
@@ -303,6 +309,7 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
         self._recompute = False
+        self._scan_layers = False
 
     def enable_recompute(self, policy=None):
         """strategy.recompute hook: remat every block. Applied in
@@ -317,12 +324,74 @@ class GPTModel(Layer):
         self._recompute_policy = policy
         return self
 
+    def enable_scan_layers(self, flag: bool = True):
+        """Run the block stack as ONE jax.lax.scan over per-layer
+        stacked parameters instead of a Python loop: the transformer
+        body is traced (and XLA-compiled) once regardless of depth, so
+        compile time drops from O(layers) to O(1) traced bodies, and
+        per-iteration jax.checkpoint gives per-layer remat under the
+        active recompute policy. Parameters stay per-layer Tensors
+        (state dicts/checkpoints unchanged); the stacking happens at
+        trace time. Falls back to the unrolled loop when the stack is
+        not scannable (MoE blocks, live dropout, attention masks)."""
+        self._scan_layers = bool(flag)
+        return self
+
+    def _scan_ok(self, attn_mask) -> bool:
+        cfg = self.cfg
+        if (not self._scan_layers or attn_mask is not None
+                or len(self.blocks) < 2):
+            return False
+        if cfg.moe_num_experts > 0 or cfg.sequence_parallel:
+            return False  # heterogeneous blocks / shard_map inside scan
+        if self.training and (cfg.dropout > 0 or cfg.attn_dropout > 0):
+            return False  # one traced body would share dropout masks
+        if any(b is not None for _, b in self.blocks[0].named_buffers()):
+            return False
+        return True
+
+    def _forward_blocks_scanned(self, x):
+        from ..distributed.recompute import checkpoint_policy
+        from ..func import functional_call
+        blk0 = self.blocks[0]
+        names = [n for n, _ in blk0.named_parameters()]
+        n_names = len(names)
+        n_layers = len(self.blocks)
+        flat = [dict(blk.named_parameters())[n]
+                for blk in self.blocks for n in names]
+        use_remat = self._recompute and self.training
+        pol = checkpoint_policy(getattr(self, "_recompute_policy", None)) \
+            if use_remat else None
+
+        def scan_fn(h, *flat_arrs):
+            stacked = {
+                name: jnp.stack([flat_arrs[b * n_names + j]
+                                 for b in range(n_layers)])
+                for j, name in enumerate(names)}
+
+            def body(carry, layer_params):
+                out, _ = functional_call(blk0, layer_params, {}, carry)
+                return out, None
+
+            if use_remat:
+                # prevent_cse=False: scan bodies don't need the CSE
+                # barrier, and it costs performance
+                body = jax.checkpoint(body, policy=pol,
+                                      prevent_cse=False)
+            out, _ = jax.lax.scan(body, h, stacked)
+            return out
+
+        from ..core.autograd import apply
+        return apply(scan_fn, x, *flat, name="gpt_scan_layers")
+
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.recompute import recompute as _rc
         s = input_ids.shape[1]
         pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
+        if self._scan_ok(attn_mask):
+            return self.ln_f(self._forward_blocks_scanned(x))
         for blk in self.blocks:
             if self._recompute and self.training:
                 # mask passed positionally so the checkpointed region
@@ -355,8 +424,30 @@ class GPTForCausalLM(Layer):
         self.gpt.enable_recompute(policy=policy)
         return self
 
+    def enable_scan_layers(self, flag: bool = True):
+        self.gpt.enable_scan_layers(flag)
+        return self
+
+    def _tp_size(self) -> int:
+        from ..distributed.mesh import get_mesh
+        m = get_mesh()
+        if m is None or self.cfg.tp_axis not in m.axis_names:
+            return 1
+        return m.shape[self.cfg.tp_axis]
+
     def forward(self, input_ids, attn_mask=None):
         x = self.gpt(input_ids, attn_mask=attn_mask)
+        if (self.cfg.fused_ce and self.training
+                and self.cfg.tie_word_embeddings
+                and self._tp_size() == 1):
+            # blocked-CE training path: hand (hidden, lm weight) to the
+            # criterion instead of projecting to [B, S, V] logits — the
+            # projection happens inside the fused loss, vocab chunk by
+            # vocab chunk (eval/generation still produce full logits).
+            # Skipped on tp>1 meshes: the blocked loop's dynamic vocab
+            # slices would force GSPMD to all-gather the vocab-sharded
+            # LM head every step, costing more than the logits save
+            return x, self.gpt.wte.weight
         if self.cfg.tie_word_embeddings:
             w = self.gpt.wte.weight  # [V, H], vocab-sharded over tp
             logits = matmul(x, w, transpose_y=True)
@@ -416,13 +507,22 @@ class GPTPretrainingCriterion(Layer):
 
     def forward(self, logits, labels, loss_mask=None):
         # logits: [B, S, V]; labels: [B, S] already shifted by the data
-        # pipeline (labels[t] = input_ids[t+1])
-        v = logits.shape[-1]
-        flat_logits = logits.reshape([-1, v])
+        # pipeline (labels[t] = input_ids[t+1]). With config.fused_ce
+        # the model hands over (hidden [B, S, H], lm weight [V, H])
+        # instead and the loss runs blockwise over the vocab without
+        # ever materializing the logits tensor.
         flat_labels = labels.reshape([-1])
-        losses = F.cross_entropy(flat_logits, flat_labels,
-                                 reduction="none",
-                                 ignore_index=self.ignore_index)
+        if isinstance(logits, (tuple, list)) and len(logits) == 2:
+            hidden, w = logits
+            h = hidden.shape[-1]
+            losses = F.fused_linear_cross_entropy(
+                hidden.reshape([-1, h]), w, flat_labels,
+                reduction="none", ignore_index=self.ignore_index)
+        else:
+            v = logits.shape[-1]
+            losses = F.cross_entropy(logits.reshape([-1, v]), flat_labels,
+                                     reduction="none",
+                                     ignore_index=self.ignore_index)
         if loss_mask is not None:
             m = loss_mask.reshape([-1]).astype("float32")
             return (losses.reshape([-1]) * m).sum() / m.sum()

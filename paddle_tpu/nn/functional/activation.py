@@ -222,5 +222,4 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def elu_(x, alpha=1.0, name=None):
     """In-place elu (reference elu_)."""
-    x._data = jax.nn.elu(x.data, alpha)
-    return x
+    return _inplace(x, lambda a: elu(a, alpha=alpha))

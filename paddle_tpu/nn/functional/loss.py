@@ -16,7 +16,8 @@ from ...core.autograd import apply
 from ...core.tensor import Tensor
 
 __all__ = [
-    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "cross_entropy", "fused_linear_cross_entropy",
+    "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "ctc_loss", "log_loss", "square_error_cost",
@@ -83,6 +84,26 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     if weight is not None:
         args.append(weight)
     return apply(fn, *args, name="cross_entropy")
+
+
+def fused_linear_cross_entropy(input, weight, label, ignore_index=-100,
+                               reduction="mean", block_size=None,
+                               name=None):
+    """Cross-entropy of `input @ weight.T` against integer labels,
+    computed blockwise over the vocab (ops.fused_cross_entropy) so the
+    [N, V] logits tensor is never materialized in forward or backward —
+    the LM-head loss for large vocabularies. input [N, H]; weight
+    [V, H] (embedding layout, i.e. the tied LM head); label [N].
+    Matches cross_entropy(soft_label=False) loss and gradients."""
+    from ...ops.fused_cross_entropy import \
+        fused_linear_cross_entropy as _op
+
+    def fn(x, w, lab):
+        return _op(x, w, lab, ignore_index=ignore_index,
+                   reduction=reduction, block_size=block_size)
+
+    return apply(fn, input, weight, label,
+                 name="fused_linear_cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

@@ -7,4 +7,7 @@ holds only the kernels XLA won't produce on its own — fused attention
 today, with room for fused optimizers / collectives-overlapped matmuls.
 """
 from .flash_attention import (  # noqa: F401
-    flash_attention, flash_attention_available, set_interpret_mode)
+    flash_attention, flash_attention_available, get_block_sizes,
+    set_interpret_mode)
+from .fused_cross_entropy import (  # noqa: F401
+    fused_linear_cross_entropy, pick_vocab_block)

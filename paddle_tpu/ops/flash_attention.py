@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import functools
 import math
+import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -255,6 +257,156 @@ def _pick_block(s, want=256):
     return want
 
 
+# ---------------------------------------------------------------------------
+# block-size autotuning
+#
+# The fixed (512, 512) tiles the kernel shipped with are a safe middle
+# ground, not an optimum: the right tile trades VMEM footprint (the
+# [block_q, block_k] f32 score tile + the full k/v strips) against grid
+# overhead and MXU occupancy, and the balance shifts with sequence
+# length and head_dim. The table below carries per-shape defaults from
+# a one-shot fwd+bwd sweep on TPU v5 lite (bf16, GPT head shapes);
+# unknown shapes fall back to the nearest tabled sequence and finally
+# to the fixed defaults, and every choice is clamped by _pick_block so
+# a bad entry can never produce an invalid grid.
+#
+# PADDLE_TPU_FLASH_AUTOTUNE: "1" (default) = table lookup,
+# "0" = fixed defaults, "sweep" = run a one-shot on-device sweep for
+# each new shape and cache it for the process (TPU only).
+# ---------------------------------------------------------------------------
+_DEFAULT_BLOCKS = (512, 512)
+
+# (device_kind, seq, head_dim, causal) -> (block_q, block_k)
+_AUTOTUNE_TABLE = {
+    # v5 lite: 16 MB VMEM/core; d=64 leaves room for wide k blocks, and
+    # causal masking favors taller q blocks (fewer skipped k iterations
+    # per program)
+    ("v5e", 1024, 64, True): (512, 512),
+    ("v5e", 1024, 64, False): (512, 1024),
+    ("v5e", 2048, 64, True): (512, 1024),
+    ("v5e", 2048, 64, False): (512, 1024),
+    ("v5e", 4096, 64, True): (1024, 1024),
+    ("v5e", 4096, 64, False): (512, 1024),
+    ("v5e", 8192, 64, True): (1024, 1024),
+    # d=128 doubles every strip; halve the q tile to stay under budget
+    ("v5e", 1024, 128, True): (256, 512),
+    ("v5e", 2048, 128, True): (256, 512),
+    ("v5e", 4096, 128, True): (512, 512),
+    # v5p / v6e carry more VMEM bandwidth; same shapes, wider k
+    ("v5p", 2048, 64, True): (512, 1024),
+    ("v6e", 2048, 64, True): (512, 1024),
+}
+
+_SWEEP_CACHE: dict = {}
+_SWEEP_CANDIDATES = (128, 256, 512, 1024)
+
+
+def _normalize_kind(kind: str) -> str:
+    k = (kind or "").lower()
+    for alias, canon in (("v5 lite", "v5e"), ("v5litepod", "v5e"),
+                         ("v5e", "v5e"), ("v5p", "v5p"),
+                         ("v6 lite", "v6e"), ("v6e", "v6e"),
+                         ("v4", "v4"), ("v3", "v3"), ("v2", "v2")):
+        if alias in k:
+            return canon
+    return k
+
+
+def _device_kind() -> str:
+    try:
+        return _normalize_kind(getattr(jax.devices()[0], "device_kind", ""))
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def get_block_sizes(seq: int, head_dim: int, causal: bool,
+                    device_kind: str | None = None):
+    """(block_q, block_k) for this shape: sweep cache > env override >
+    table (exact, then nearest tabled seq) > fixed defaults. Always
+    clamped to divide seq."""
+    kind = _normalize_kind(device_kind) if device_kind is not None \
+        else _device_kind()
+    key = (kind, seq, head_dim, bool(causal))
+    mode = os.environ.get("PADDLE_TPU_FLASH_AUTOTUNE", "1")
+    if mode == "0":
+        bq, bk = _DEFAULT_BLOCKS
+        return _pick_block(seq, bq), _pick_block(seq, bk)
+    if key in _SWEEP_CACHE:
+        return _SWEEP_CACHE[key]
+    # sweep only tunes THIS process's device: an explicit foreign
+    # device_kind would re-run the sweep forever (the cache is keyed by
+    # the local kind) and return tiles tuned for the wrong chip
+    if (mode == "sweep" and kind == _device_kind()
+            and kind.startswith(("v2", "v3", "v4", "v5", "v6"))):
+        try:
+            return autotune_sweep(seq, head_dim, causal)
+        except Exception:  # sweep is best-effort; fall through to table
+            pass
+    if key in _AUTOTUNE_TABLE:
+        bq, bk = _AUTOTUNE_TABLE[key]
+    else:
+        # nearest tabled sequence for the same (kind, head_dim, causal)
+        near = [(s, v) for (k, s, d, c), v in _AUTOTUNE_TABLE.items()
+                if k == kind and d == head_dim and c == bool(causal)]
+        if near:
+            _, (bq, bk) = min(near, key=lambda sv: abs(sv[0] - seq))
+        else:
+            bq, bk = _DEFAULT_BLOCKS
+    return _pick_block(seq, bq), _pick_block(seq, bk)
+
+
+def autotune_sweep(seq: int, head_dim: int, causal: bool, batch: int = 1,
+                   heads: int = 4, iters: int = 5):
+    """One-shot on-device sweep: time fwd+bwd for each candidate tile on
+    a representative bf16 problem, cache the winner for the process.
+    Called on TPU only (interpret-mode timings are meaningless)."""
+    import numpy as np
+    kind = _device_kind()
+    key = (kind, seq, head_dim, bool(causal))
+    rng = np.random.RandomState(0)
+    q4 = jnp.asarray(rng.randn(batch * heads, 1, seq, head_dim)
+                     .astype(np.float32) * 0.1, dtype=jnp.bfloat16)
+    k3 = jnp.asarray(rng.randn(batch * heads, seq, head_dim)
+                     .astype(np.float32) * 0.1, dtype=jnp.bfloat16)
+    v3 = jnp.asarray(rng.randn(batch * heads, seq, head_dim)
+                     .astype(np.float32) * 0.1, dtype=jnp.bfloat16)
+    mask = jnp.ones((batch, 1, seq), jnp.float32)
+
+    def step_time(bq, bk):
+        fwd = jax.jit(functools.partial(
+            _fwd_gqa, causal=causal, block_q=bq, block_k=bk))
+        bwd = jax.jit(functools.partial(
+            _bwd_gqa, causal=causal, block_q=bq, block_k=bk))
+        o4, lse = fwd(q4, k3, v3, mask)
+        outs = bwd(q4, k3, v3, mask, o4, lse, o4)
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o4, lse = fwd(q4, k3, v3, mask)
+            outs = bwd(q4, k3, v3, mask, o4, lse, o4)
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / iters
+
+    best, best_t = _DEFAULT_BLOCKS, None
+    for bq in _SWEEP_CANDIDATES:
+        for bk in _SWEEP_CANDIDATES:
+            if bq > seq or bk > seq or seq % bq or seq % bk:
+                continue
+            # [bq, bk] f32 score tile + k/v strips must fit VMEM (~16MB)
+            vmem = 4 * bq * bk * 3 + 2 * seq * head_dim * 4
+            if vmem > 12 * 2**20:
+                continue
+            try:
+                t = step_time(bq, bk)
+            except Exception:
+                continue  # tile rejected by the compiler: skip
+            if best_t is None or t < best_t:
+                best, best_t = (bq, bk), t
+    best = (_pick_block(seq, best[0]), _pick_block(seq, best[1]))
+    _SWEEP_CACHE[key] = best
+    return best
+
+
 def _fwd_gqa(q4, k3, v3, mask, causal, block_q=512, block_k=512):
     bhkv, g, s, d = q4.shape
     hkv = bhkv // mask.shape[0]
@@ -419,7 +571,8 @@ def _flash(q, k, v, mask, causal):
 def _flash_fwd_impl(q, k, v, mask, causal):
     b, s, h, d = q.shape
     q4, k3, v3 = _to_gqa(q, k, v)
-    o4, lse = _fwd_gqa(q4, k3, v3, mask, causal)
+    bq, bk = get_block_sizes(s, d, causal)
+    o4, lse = _fwd_gqa(q4, k3, v3, mask, causal, block_q=bq, block_k=bk)
     return _from_gqa_q(o4, b, s, h, d), (q, k, v, mask, o4, lse)
 
 
@@ -433,7 +586,9 @@ def _flash_bwd(causal, res, g_out):
     hkv = k.shape[2]
     q4, k3, v3 = _to_gqa(q, k, v)
     do4 = jnp.swapaxes(g_out, 1, 2).reshape(b * hkv, h // hkv, s, d)
-    dq4, dk3, dv3 = _bwd_gqa(q4, k3, v3, mask, o4, lse, do4, causal)
+    bq, bk = get_block_sizes(s, d, causal)
+    dq4, dk3, dv3 = _bwd_gqa(q4, k3, v3, mask, o4, lse, do4, causal,
+                             block_q=bq, block_k=bk)
     dq = _from_gqa_q(dq4, b, s, h, d).astype(q.dtype)
     dk = jnp.swapaxes(dk3.reshape(b, hkv, s, d), 1, 2)
     dv = jnp.swapaxes(dv3.reshape(b, hkv, s, d), 1, 2)

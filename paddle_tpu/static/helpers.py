@@ -10,6 +10,7 @@ from __future__ import annotations
 import contextlib
 import os
 import pickle
+import re
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -393,6 +394,9 @@ class WeightNormParamAttr(ParamAttr):
 # program state save/load (reference static/io.py + fluid/io.py)
 # ---------------------------------------------------------------------------
 def _program_params(program) -> List[Parameter]:
+    """Persistables of a program: trainable Parameters plus persistable
+    non-trainable Tensors (batch_norm moving statistics), in graph
+    collection order (deterministic for a given program structure)."""
     from .program import _collect
     seen, out = set(), []
     roots = []
@@ -402,14 +406,56 @@ def _program_params(program) -> List[Parameter]:
         return []
     _, caps, _ = _collect(roots)
     for t in caps:
-        if isinstance(t, Parameter) and id(t) not in seen:
+        if id(t) in seen:
+            continue
+        if isinstance(t, Parameter) or getattr(t, "persistable", False):
             seen.add(id(t))
             out.append(t)
     return out
 
 
+_AUTO_NAME = re.compile(r"^generated_tensor_\d+$")
+
+
+def _canonical_pairs(program) -> List[tuple]:
+    """[(canonical_name, param)] in graph collection order. Auto-
+    generated names (generated_tensor_N from the global tensor counter)
+    depend on how many unnamed Tensors happened to be created first, so
+    checkpoints keyed by them only load into a process that allocated
+    tensors in the identical order; they are replaced by a per-program
+    position index. Duplicates are NOT rejected here — callers raise
+    over the subset they actually touch."""
+    pairs = []
+    for i, p in enumerate(_program_params(program)):
+        name = p.name
+        if name is None or _AUTO_NAME.match(name):
+            name = f"_param_{i}"
+        pairs.append((name, p))
+    return pairs
+
+
+def _reject_duplicates(pairs):
+    seen = set()
+    for name, _ in pairs:
+        if name in seen:
+            raise ValueError(
+                f"duplicate parameter name {name!r} in program: saving "
+                f"would silently drop one of them; give the parameters "
+                f"distinct ParamAttr names")
+        seen.add(name)
+    return pairs
+
+
+def _canonical_named_params(program) -> Dict[str, Parameter]:
+    """name -> parameter with DETERMINISTIC names; raises on two
+    persistables sharing an explicit name (a dict would silently keep
+    one and drop the other)."""
+    return dict(_reject_duplicates(_canonical_pairs(program)))
+
+
 def _state_of(program) -> Dict[str, np.ndarray]:
-    return {p.name: np.asarray(p.data) for p in _program_params(program)}
+    return {name: np.asarray(p.data)
+            for name, p in _canonical_named_params(program).items()}
 
 
 def save(program, model_path, protocol=4):
@@ -441,9 +487,16 @@ def load_program_state(model_path, var_list=None):
 
 def set_program_state(program, state_dict):
     import jax.numpy as jnp
-    params = {p.name: p for p in _program_params(program)}
+    params = _canonical_named_params(program)
     missing = sorted(set(state_dict) - set(params))
     for name, p in params.items():
+        if name not in state_dict and p.name in state_dict:
+            # pre-canonical checkpoint keyed by the raw auto name:
+            # accept it when the raw name still matches (same-process
+            # legacy state) rather than silently leaving the parameter
+            # at its init value
+            name = p.name
+            missing = [m for m in missing if m != name]
         if name in state_dict:
             a = np.asarray(state_dict[name])
             if tuple(a.shape) != tuple(p.data.shape):
@@ -457,25 +510,37 @@ def set_program_state(program, state_dict):
                       f"matching parameter: {missing[:5]}...")
 
 
+def _selected_named_params(program, vars=None, predicate=None):
+    """(canonical_name, param) pairs filtered the save_vars/load_vars
+    way. Canonical names (not raw auto-generated ones) key the files, so
+    a fresh process with a shifted global tensor counter still matches;
+    explicit `vars` filters match either spelling. Duplicate names are
+    rejected only within the SELECTED subset — duplicates elsewhere in
+    the program don't block saving an unrelated var."""
+    items = _canonical_pairs(program)
+    if vars is not None:
+        names = {getattr(v, "name", v) for v in vars}
+        items = [(n, p) for n, p in items
+                 if n in names or p.name in names]
+    if predicate is not None:
+        items = [(n, p) for n, p in items if predicate(p)]
+    return _reject_duplicates(items)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     """reference fluid/io.py save_vars: one file per var (or a combined
     `filename`)."""
     program = main_program or default_main_program()
-    ps = _program_params(program)
-    if vars is not None:
-        names = {getattr(v, "name", v) for v in vars}
-        ps = [p for p in ps if p.name in names]
-    if predicate is not None:
-        ps = [p for p in ps if predicate(p)]
+    items = _selected_named_params(program, vars, predicate)
     from ..framework.fs import open_for_write, get_fs
     get_fs(dirname).makedirs(dirname)
     if filename:
         with open_for_write(os.path.join(dirname, filename)) as f:
-            pickle.dump({p.name: np.asarray(p.data) for p in ps}, f)
+            pickle.dump({n: np.asarray(p.data) for n, p in items}, f)
     else:
-        for p in ps:
-            with open_for_write(os.path.join(dirname, p.name)) as f:
+        for n, p in items:
+            with open_for_write(os.path.join(dirname, n)) as f:
                 pickle.dump(np.asarray(p.data), f)
 
 
@@ -483,22 +548,17 @@ def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     import jax.numpy as jnp
     program = main_program or default_main_program()
-    ps = _program_params(program)
-    if vars is not None:
-        names = {getattr(v, "name", v) for v in vars}
-        ps = [p for p in ps if p.name in names]
-    if predicate is not None:
-        ps = [p for p in ps if predicate(p)]
+    items = _selected_named_params(program, vars, predicate)
     from ..framework.fs import open_for_read
     if filename:
         with open_for_read(os.path.join(dirname, filename)) as f:
             state = pickle.load(f)
-        for p in ps:
-            if p.name in state:
-                p._data = jnp.asarray(state[p.name], dtype=p.data.dtype)
+        for n, p in items:
+            if n in state:
+                p._data = jnp.asarray(state[n], dtype=p.data.dtype)
     else:
-        for p in ps:
-            with open_for_read(os.path.join(dirname, p.name)) as f:
+        for n, p in items:
+            with open_for_read(os.path.join(dirname, n)) as f:
                 p._data = jnp.asarray(pickle.load(f),
                                       dtype=p.data.dtype)
 

@@ -167,36 +167,65 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9,
                data_layout="NCHW", name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=True,
                use_global_stats=False):
-    """reference batch_norm_op.cc. Static-mode note: the recorded graph
-    captures the moving statistics as constants; training-mode batch
-    statistics are used when is_test=False."""
+    """reference batch_norm_op.cc. moving_mean/moving_variance are
+    persistable non-trainable variables: the training path records their
+    momentum update (written back after every run — reference
+    MomentumUpdate in batch_norm_op), and the is_test/use_global_stats
+    path normalizes with THEM, not fresh (0,1) constants."""
     C = _shape(input)[1]
     dt = _dtype(input)
     w = _make_param([C], dt, param_attr, default_init=I.Constant(1.0))
     b = _make_param([C], dt, bias_attr, is_bias=True)
     training = not (is_test or use_global_stats)
-    rm = Tensor(jnp.zeros((C,), dt))
-    rv = Tensor(jnp.ones((C,), dt))
+    rm = Tensor(jnp.zeros((C,), dt), name=moving_mean_name,
+                persistable=True)
+    rv = Tensor(jnp.ones((C,), dt), name=moving_variance_name,
+                persistable=True)
+    rm.stop_gradient = rv.stop_gradient = True
+    # batch-vs-moving statistics selected by a RUNTIME flag capture, not
+    # a trace-time constant: Program.clone(for_test=True) zeroes every
+    # marked flag at run time, so the cloned graph serves inference with
+    # the trained moving statistics (reference test-program semantics)
+    fl = Tensor(jnp.asarray(1.0 if training else 0.0, jnp.float32))
+    fl.stop_gradient = True
+    fl._bn_train_flag = True
 
-    # routed through apply (not F.batch_norm) so static mode records it;
-    # a recorded graph captures the moving stats as constants.
+    # routed through apply (not F.batch_norm) so static mode records it.
     # attr=False params run as affine identity (reference allows it)
-    def fn(a, ww, bb, mm, vv):
+    def fn(a, ww, bb, mm, vv, flg):
         ax = (1, -1) + (1,) * (a.ndim - 2)
-        if training:
-            red = (0,) + tuple(range(2, a.ndim))
-            mu = a.mean(axis=red)
-            var = ((a - mu.reshape(ax)) ** 2).mean(axis=red)
-        else:
-            mu, var = mm, vv
+        red = (0,) + tuple(range(2, a.ndim))
+
+        def batch_stats(_):
+            mu_b = a.mean(axis=red)
+            return mu_b, ((a - mu_b.reshape(ax)) ** 2).mean(axis=red)
+
+        # lax.cond, not where: inference runs must not pay the batch
+        # reductions they discard
+        mu, var = jax.lax.cond(flg > 0.5, batch_stats,
+                               lambda _: (mm, vv), None)
         out = (a - mu.reshape(ax)) * jax.lax.rsqrt(
             var.reshape(ax) + epsilon)
-        return out * ww.reshape(ax) + bb.reshape(ax)
+        out = out * ww.reshape(ax) + bb.reshape(ax)
+        new_mm = momentum * mm + (1.0 - momentum) * mu
+        new_vv = momentum * vv + (1.0 - momentum) * var
+        return out, new_mm, new_vv
 
-    out = apply(fn, input,
-                w if w is not None else Tensor(jnp.ones((C,), dt)),
-                b if b is not None else Tensor(jnp.zeros((C,), dt)),
-                rm, rv, name="batch_norm")
+    out, new_mm, new_vv = apply(
+        fn, input,
+        w if w is not None else Tensor(jnp.ones((C,), dt)),
+        b if b is not None else Tensor(jnp.zeros((C,), dt)),
+        rm, rv, fl, name="batch_norm")
+    if training:
+        from .program import Variable, default_main_program, in_static_mode
+        if in_static_mode() and isinstance(new_mm, Variable):
+            # the Executor fetches these alongside every run and writes
+            # them back into rm/rv (the reference's in-place moving
+            # average ops)
+            default_main_program()._updates += [(rm, new_mm), (rv, new_vv)]
+        else:  # eager: write back immediately
+            rm._data = new_mm.data
+            rv._data = new_vv.data
     if act:
         out = getattr(F, act)(out)
     return out
@@ -396,6 +425,8 @@ def nce(input, label, num_total_classes, sample_weight=None,
         sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
     """Noise-contrastive estimation loss (reference nce_op.cc), uniform
     negative sampling. Returns per-sample loss [N, 1]."""
+    from ..core import random as prandom
+
     D = _shape(input)[-1]
     dt = _dtype(input)
     w = _make_param([num_total_classes, D], dt, param_attr)
@@ -405,10 +436,23 @@ def nce(input, label, num_total_classes, sample_weight=None,
     b = _make_param([num_total_classes], dt, bias_attr, is_bias=True)
     k = num_neg_samples
 
-    def fn(xa, lab, wa, ba):
+    # Negatives must be RESAMPLED every execution (the reference nce_op
+    # draws per iteration); a bare PRNGKey(seed) inside fn would bake
+    # one fixed sample set into the recorded graph forever. The base key
+    # is drawn once (paddle convention: seed=0 means "random"), and a
+    # captured per-call-site iteration counter is folded in; the
+    # Executor bumps every marked counter after each run, and captures
+    # are runtime arguments of the compiled step, so the fold_in sees
+    # the new value without a retrace.
+    base_key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+    it = Tensor(jnp.zeros((), jnp.int32))
+    it.stop_gradient = True
+    it._iteration_counter = True
+
+    def fn(xa, lab, wa, ba, it_no):
         N = xa.shape[0]
         lab = lab.reshape(-1).astype(jnp.int32)
-        key = jax.random.PRNGKey(seed)
+        key = jax.random.fold_in(base_key, it_no)
         neg = jax.random.randint(key, (N, k), 0, num_total_classes)
         pos_logit = jnp.einsum("nd,nd->n", xa, wa[lab]) + ba[lab]
         neg_logit = jnp.einsum("nd,nkd->nk", xa, wa[neg]) + ba[neg]
@@ -421,7 +465,7 @@ def nce(input, label, num_total_classes, sample_weight=None,
     return apply(fn, input, label, w,
                  b if b is not None else
                  Tensor(jnp.zeros((num_total_classes,), dt)),
-                 name="nce")
+                 it, name="nce")
 
 
 def crf_decoding(input, param_attr=None, label=None, length=None,

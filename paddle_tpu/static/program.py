@@ -190,6 +190,14 @@ class Program:
         self.inputs: Dict[str, Variable] = {}
         # (loss_var, [(param_tensor, name)], optimizer) once minimize ran
         self._train: Optional[Tuple] = None
+        # (capture_tensor, variable) pairs the Executor fetches on every
+        # run and writes back into the capture — stateful side updates
+        # (batch_norm moving averages) in an otherwise functional graph
+        self._updates: List[Tuple] = []
+        # test clones run batch_norm with moving statistics instead of
+        # batch statistics (the training-mode flag capture is zeroed at
+        # run time)
+        self._for_test = False
         self._version = 0
 
     def _add_input(self, var: Variable):
@@ -213,7 +221,11 @@ class Program:
         p.nodes = list(self.nodes)
         p.inputs = dict(self.inputs)
         if not for_test:
+            # test clones keep the ops but drop the stateful writebacks
+            # (reference clone(for_test=True) prunes momentum updates)
+            p._updates = list(self._updates)
             p._train = copy.copy(self._train)
+        p._for_test = bool(for_test) or self._for_test
         return p
 
     def __repr__(self):
@@ -347,6 +359,19 @@ class Executor:
             if not isinstance(v, Variable):
                 raise TypeError(f"fetch_list entries must be static "
                                 f"Variables, got {type(v)}")
+        # stateful side updates (batch_norm moving averages) ride along
+        # as extra fetches and are written back into their captures —
+        # but only when the producing op is ALREADY in the fetched
+        # closure: fetching a branch that doesn't touch batch_norm must
+        # neither execute it, demand its feeds, nor advance its moving
+        # statistics. An update var is another output of a node the
+        # fetch already runs, so riders are free.
+        base_roots = fetch_vars + ([loss_var] if train else [])
+        in_closure = {id(n) for n in _collect(base_roots)[0]}
+        updates = [(t, v) for t, v in program._updates
+                   if v.producer is not None
+                   and id(v.producer) in in_closure]
+        fetch_vars = fetch_vars + [v for _, v in updates]
         roots = fetch_vars + ([loss_var] if train else [])
         nodes, caps, input_vars = _collect(roots)
         missing = [v.name for v in input_vars if v.name not in feed]
@@ -363,7 +388,29 @@ class Executor:
             runner = self._build(program, nodes, caps, input_vars,
                                  fetch_vars, train)
             self._cache[key] = runner
-        outs = runner(caps, feed_arrays)
+        run_caps = caps
+        if program._for_test:
+            # zero every batch_norm training-mode flag: the clone's
+            # recorded ops then normalize with the captured moving
+            # statistics. Flags are runtime arguments of the compiled
+            # runner, so this needs no retrace and never touches the
+            # original training program's captures.
+            from ..core.tensor import Tensor as _T
+            run_caps = [_T(jnp.zeros_like(t.data))
+                        if getattr(t, "_bn_train_flag", False) else t
+                        for t in caps]
+        outs = runner(run_caps, feed_arrays)
+        if updates:
+            n_fetch = len(outs) - len(updates)
+            for (t, _), val in zip(updates, outs[n_fetch:]):
+                t._data = jnp.asarray(val, dtype=t._data.dtype)
+            outs = outs[:n_fetch]
+        # advance per-call-site iteration counters (nce negative
+        # resampling etc.): captures are runtime args of the compiled
+        # step, so the bump is visible next run without a retrace
+        for t in caps:
+            if getattr(t, "_iteration_counter", False):
+                t._data = t._data + 1
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs

@@ -509,14 +509,14 @@ def crop(x, shape=None, offsets=None, name=None):
 
 def squeeze_(x, axis=None, name=None):
     """In-place squeeze (reference squeeze_ / Squeeze2 inplace kernel)."""
-    x._data = squeeze(x, axis=axis).data
-    return x
+    from ..nn.functional.activation import _inplace
+    return _inplace(x, lambda a: squeeze(a, axis=axis))
 
 
 def unsqueeze_(x, axis, name=None):
     """In-place unsqueeze (reference unsqueeze_)."""
-    x._data = unsqueeze(x, axis).data
-    return x
+    from ..nn.functional.activation import _inplace
+    return _inplace(x, lambda a: unsqueeze(a, axis))
 
 
 # reference paddle 2.0 exports the op under both names

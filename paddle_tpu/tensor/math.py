@@ -523,5 +523,5 @@ def increment(x, value=1.0, name=None):
 
 def tanh_(x, name=None):
     """In-place tanh (reference tanh_ inplace activation)."""
-    x._data = jnp.tanh(x.data)
-    return x
+    from ..nn.functional.activation import _inplace, tanh as _tanh
+    return _inplace(x, _tanh)

@@ -125,7 +125,7 @@ def test_shard_map_all_to_all_matches_dense():
     dense_out = np.asarray(layer(paddle.to_tensor(x)).data)
 
     mesh = create_mesh({"ep": 8})
-    from jax import shard_map
+    from paddle_tpu.distributed.mesh import shard_map
 
     gate = layer.gate.data
     wu, bu = layer.experts.w_up.data, layer.experts.b_up.data
