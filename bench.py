@@ -91,8 +91,12 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     st.recompute_configs = {"policy": "dots_no_batch",
                             "scan_layers": scan_layers}
     mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    # resilience config rides the perf trajectory: the anomaly policy is
+    # part of the measured step (skip compiles an extra finite-check +
+    # select into the executable)
+    anomaly_policy = os.environ.get("BENCH_ANOMALY_POLICY", "raise")
     trainer = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh,
-                          strategy=st)
+                          strategy=st, anomaly_policy=anomaly_policy)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -130,6 +134,24 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     loss.block_until_ready()
     dt = time.perf_counter() - t0
 
+    # async checkpoint cost: what the TRAIN THREAD pays for a save (the
+    # device->host snapshot; serialization+commit run in the background)
+    ckpt_save_ms = ckpt_async = None
+    try:
+        import tempfile
+        from paddle_tpu.distributed.resilience import CheckpointManager
+        with tempfile.TemporaryDirectory() as td:
+            mgr = CheckpointManager(td, keep_last=1, async_save=True)
+            t0 = time.perf_counter()
+            mgr.save(trainer, step=trainer._step_count)
+            ckpt_save_ms = round((time.perf_counter() - t0) * 1e3, 2)
+            mgr.wait()
+            ckpt_async = True
+            log(f"  ckpt: train-thread blocked {ckpt_save_ms}ms, "
+                f"commit {mgr.last_commit_ms:.0f}ms (background)")
+    except Exception as e:
+        log(f"  ckpt bench skipped: {type(e).__name__}: {e}")
+
     step_ms = dt / steps * 1e3
     tokens_per_sec = batch * seq * steps / dt
     flops_tok = cfg.flops_per_token(seq)
@@ -151,6 +173,9 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
             seq, cfg.hidden_size // cfg.num_heads)) if use_flash else None,
         "remat": remat,
         "remat_policy": "dots_no_batch" if remat else "off",
+        "anomaly_policy": anomaly_policy,
+        "ckpt_save_ms": ckpt_save_ms,
+        "ckpt_async": ckpt_async,
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }

@@ -18,16 +18,26 @@ __all__ = ["GradScaler", "AmpScaler"]
 class AmpScaler:
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True,
+                 min_loss_scaling=1.0):
         self._enable = enable
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every_n_steps = incr_every_n_steps
         self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        # floor: a long non-finite streak halves the scale only down to
+        # here — an unbounded decay would reach denormals/zero and turn
+        # every later gradient into garbage
+        self._min_scale = float(min_loss_scaling)
         self._use_dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
+        # lifetime counters (survive checkpoint/resume via state_dict):
+        # finite steps, non-finite steps, optimizer updates skipped
+        self._total_good_steps = 0
+        self._total_bad_steps = 0
+        self._skipped_steps = 0
         self._found_inf = False
         self._unscaled = False
 
@@ -85,6 +95,8 @@ class AmpScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            self._skipped_steps += 1
         self._unscaled = False
 
     def update(self):
@@ -96,12 +108,15 @@ class AmpScaler:
         if self._found_inf:
             self._good_steps = 0
             self._bad_steps += 1
+            self._total_bad_steps += 1
             if self._bad_steps >= self._decr_every_n_nan_or_inf:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(self._scale * self._decr_ratio,
+                                  self._min_scale)
                 self._bad_steps = 0
         else:
             self._bad_steps = 0
             self._good_steps += 1
+            self._total_good_steps += 1
             if self._good_steps >= self._incr_every_n_steps:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
@@ -112,14 +127,22 @@ class AmpScaler:
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every_n_steps,
                 "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "min_loss_scaling": self._min_scale,
                 "good_steps": self._good_steps,
                 "bad_steps": self._bad_steps,
+                "total_good_steps": self._total_good_steps,
+                "total_bad_steps": self._total_bad_steps,
+                "skipped_steps": self._skipped_steps,
                 "use_dynamic_loss_scaling": self._use_dynamic}
 
     def load_state_dict(self, sd):
         self._scale = sd.get("scale", self._scale)
+        self._min_scale = sd.get("min_loss_scaling", self._min_scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+        self._total_good_steps = sd.get("total_good_steps", 0)
+        self._total_bad_steps = sd.get("total_bad_steps", 0)
+        self._skipped_steps = sd.get("skipped_steps", 0)
 
     set_state_dict = load_state_dict
 

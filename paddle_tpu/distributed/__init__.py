@@ -31,5 +31,7 @@ from .ring_attention import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_trainer, load_trainer, latest_checkpoint)
+from . import resilience  # noqa: F401
+from .resilience import CheckpointManager, PreemptionGuard  # noqa: F401
 from . import launch as launch_mod  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -9,34 +9,76 @@ persistable vars.
 
 TPU-native: the compiled trainers own sharded device arrays; checkpoint
 = host-gather the pytrees (numpy) + a small metadata dict, restore =
-device_put each leaf back with its recorded NamedSharding. The file is
-a single pickle (the framework's save format, framework/io.py) — the
+device_put each leaf back with its recorded NamedSharding. The
 shardings themselves are NOT stored, they come from the rebuilt
 trainer, so a checkpoint written on one mesh layout restores onto
 another (e.g. dp8 -> dp4) as long as the model matches.
+
+Two on-disk formats:
+- legacy single file: one pickle written through fs.open_for_write
+  (atomic tmp+rename, now fsync'd);
+- manifest directory (Check-N-Run-style verified checkpoints): the
+  pickle payload plus MANIFEST.json carrying a sha256 + size per entry,
+  written LAST inside a `<name>.tmp` staging dir that is renamed into
+  place — so a checkpoint directory that exists at its final name
+  always has its manifest, and a manifest that validates proves the
+  payload is the exact bytes the writer produced. Truncation, partial
+  upload, or bitrot all fail validation and resume falls back to the
+  previous valid snapshot instead of crashing.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
+import shutil
 from typing import Optional
 
 import numpy as np
 
 import jax
 
-__all__ = ["save_trainer", "load_trainer", "latest_checkpoint"]
+__all__ = ["save_trainer", "load_trainer", "latest_checkpoint",
+           "snapshot_trainer", "restore_trainer", "write_checkpoint",
+           "read_checkpoint", "validate_checkpoint",
+           "checkpoint_candidates", "gc_stale_tmps"]
 
 _FORMAT = "paddle_tpu_trainer_ckpt_v1"
+_MANIFEST_FORMAT = "paddle_tpu_ckpt_manifest_v1"
+_MANIFEST = "MANIFEST.json"
+_STATE_ENTRY = "state.pdtrainer"
 
 
 def _to_host(tree):
-    return jax.tree_util.tree_map(np.asarray, tree)
+    """Device -> host snapshot that OWNS its memory.
+
+    np.asarray on a CPU-backend jax array is a zero-copy view into the
+    device buffer; once the next donated train step reuses that buffer
+    the 'snapshot' silently tracks the live (possibly NaN-poisoned)
+    params. Anything captured for later use — async checkpoint payloads,
+    rollback snapshots — must copy when numpy hands back a view (base
+    is None exactly when the conversion already copied, e.g. on TPU)."""
+    def conv(a):
+        out = np.asarray(a)
+        if out.base is not None:
+            out = out.copy()
+        return out
+    return jax.tree_util.tree_map(conv, tree)
 
 
-def save_trainer(trainer, path: str, extra: Optional[dict] = None) -> str:
-    """Persist a trainer's full training state (params + optimizer state
-    + step count + LR-scheduler state [+ gradient-merge buffer])."""
+# ---------------------------------------------------------------------------
+# trainer state <-> host pytree
+# ---------------------------------------------------------------------------
+def snapshot_trainer(trainer, extra: Optional[dict] = None) -> dict:
+    """Device -> host snapshot of a trainer's full training state
+    (params + optimizer state + step count + LR-scheduler state
+    [+ gradient-merge buffer, fp16 scaler, anomaly counters]).
+
+    This is the only part of a save that must run on the training
+    thread (it synchronizes with the device); serialization and disk
+    I/O can happen on a background thread (resilience.CheckpointManager).
+    """
     from ..optimizer.lr import LRScheduler
     state = {
         "format": _FORMAT,
@@ -51,16 +93,12 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None) -> str:
         state["grad_buf"] = _to_host(trainer._grad_buf)
     if getattr(trainer, "_scaler_state", None) is not None:
         state["scaler"] = _to_host(trainer._scaler_state)
+    if getattr(trainer, "_anomaly_state", None) is not None:
+        state["anomaly"] = _to_host(trainer._anomaly_state)
     lr = getattr(trainer.optimizer, "_lr", None)
     if isinstance(lr, LRScheduler):
         state["lr_scheduler"] = lr.state_dict()
-    # fs backend (reference framework/io/fs.cc): local paths write
-    # tmp+rename (atomic — a killed save never corrupts), hdfs:// paths
-    # stage locally and upload
-    from ..framework.fs import open_for_write
-    with open_for_write(path, "wb") as f:
-        pickle.dump(state, f)
-    return path
+    return state
 
 
 def _restore_tree(host_tree, live_tree, shardings):
@@ -81,16 +119,13 @@ def _restore_tree(host_tree, live_tree, shardings):
     return jax.tree_util.tree_unflatten(l_def, out)
 
 
-def load_trainer(trainer, path: str) -> dict:
-    """Restore `save_trainer` state into a (re)built trainer; shardings
-    come from the trainer, so the mesh layout may differ from the one
-    that wrote the checkpoint. Returns the 'extra' metadata dict."""
+def restore_trainer(trainer, state: dict) -> dict:
+    """Apply a snapshot_trainer() state dict to a (re)built trainer;
+    shardings come from the trainer, so the mesh layout may differ from
+    the one that wrote the checkpoint. Returns the 'extra' dict."""
     from ..optimizer.lr import LRScheduler
-    from ..framework.fs import open_for_read
-    with open_for_read(path, "rb") as f:
-        state = pickle.load(f)
     if state.get("format") != _FORMAT:
-        raise ValueError(f"{path} is not a {_FORMAT} checkpoint")
+        raise ValueError(f"state is not a {_FORMAT} checkpoint")
     trainer.params = _restore_tree(state["params"], trainer.params,
                                    trainer._param_shardings)
     trainer.opt_state = _restore_tree(state["opt_state"],
@@ -108,6 +143,25 @@ def load_trainer(trainer, path: str) -> dict:
         trainer._scaler_state = _restore_tree(
             state["scaler"], trainer._scaler_state,
             trainer._scaler_shardings)
+    if getattr(trainer, "_anomaly_state", None) is not None:
+        if "anomaly" in state:
+            trainer._anomaly_state = _restore_tree(
+                state["anomaly"], trainer._anomaly_state,
+                trainer._anomaly_shardings)
+        else:
+            # checkpoint written without anomaly state (raise-policy or
+            # pre-resilience run): every recorded step was applied, so
+            # the optimizer-visible counter equals the global count —
+            # leaving t=0 would rewind Adam bias correction to step 1
+            import jax.numpy as jnp
+            trainer._anomaly_state = {
+                "t": jax.device_put(
+                    jnp.asarray(int(state["step_count"]), jnp.int32),
+                    trainer._anomaly_shardings["t"]),
+                "skipped": jax.device_put(
+                    jnp.asarray(0, jnp.int32),
+                    trainer._anomaly_shardings["skipped"]),
+            }
     trainer._step_count = int(state["step_count"])
     ksteps = getattr(trainer, "k_steps", 1)
     trainer.optimizer._step_count = trainer._step_count // max(ksteps, 1)
@@ -117,18 +171,188 @@ def load_trainer(trainer, path: str) -> dict:
     return state.get("extra", {})
 
 
-def latest_checkpoint(directory: str, prefix: str = "ckpt-"):
-    """Newest `{prefix}{step}` file in directory (auto-resume lookup,
-    reference AutoCheckpointChecker.get_range_checkpoint_path)."""
+# ---------------------------------------------------------------------------
+# on-disk formats
+# ---------------------------------------------------------------------------
+def _rm(path: str):
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def checkpoint_candidates(directory: str, prefix: str = "ckpt-"):
+    """Committed `{prefix}{int step}` entries as (step, path), newest
+    first — the single definition of 'what counts as a checkpoint'
+    shared by latest_checkpoint and resilience.CheckpointManager."""
     if not os.path.isdir(directory):
-        return None
-    best, best_step = None, -1
+        return []
+    out = []
     for name in os.listdir(directory):
-        if name.startswith(prefix) and not name.endswith(".tmp"):
+        if not name.startswith(prefix) or name.endswith(".tmp"):
+            continue
+        try:
+            step = int(name[len(prefix):])
+        except ValueError:
+            continue
+        out.append((step, os.path.join(directory, name)))
+    return sorted(out, reverse=True)
+
+
+def gc_stale_tmps(directory: str, prefix: str = "ckpt-"):
+    """Remove `.tmp` staging orphans left by crashed saves. Call only
+    when no writer is active on the directory (resume time / after a
+    commit in the single-writer CheckpointManager)."""
+    if not os.path.isdir(directory):
+        return
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(".tmp"):
             try:
-                step = int(name[len(prefix):])
-            except ValueError:
-                continue
-            if step > best_step:
-                best, best_step = os.path.join(directory, name), step
-    return best
+                _rm(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
+def write_checkpoint(state: dict, path: str) -> str:
+    """Commit `state` as a manifest directory at `path`.
+
+    Protocol: serialize into `path + ".tmp"`, fsync the payload, write
+    MANIFEST.json (checksums) LAST, fsync it, then atomically rename the
+    staging dir to `path`. A crash at any point leaves either the old
+    checkpoint or a `.tmp` orphan (GC'd by latest_checkpoint /
+    CheckpointManager), never a half-committed final directory.
+    """
+    from ..framework.fs import fsync_file, _fsync_dir
+    tmp = path + ".tmp"
+    _rm(tmp)
+    os.makedirs(tmp)
+    payload = pickle.dumps(state, protocol=4)
+    with open(os.path.join(tmp, _STATE_ENTRY), "wb") as f:
+        f.write(payload)
+        fsync_file(f)
+    manifest = {
+        "format": _MANIFEST_FORMAT,
+        "step": int(state.get("step_count", -1)),
+        "entries": {_STATE_ENTRY: {
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "size": len(payload),
+        }},
+    }
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f)
+        fsync_file(f)
+    if os.path.exists(path):
+        # re-save of the same step: rename the old one aside first so
+        # the no-checkpoint window is two rename syscalls, not a
+        # multi-GB delete; the ".old.tmp" suffix makes a crash-orphaned
+        # copy invisible to candidates and GC'd like any staging dir
+        old = path + ".old.tmp"
+        _rm(old)
+        os.rename(path, old)
+        os.rename(tmp, path)
+        _rm(old)
+    else:
+        os.rename(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+    return path
+
+
+def validate_checkpoint(path: str) -> bool:
+    """Cheap integrity check without a full restore.
+
+    Manifest directories verify size + sha256 of every entry against
+    MANIFEST.json; legacy single-file checkpoints get a pickle-header
+    sniff (first byte \\x80) — and hapi's eager-mode JSON markers (first
+    byte '{') also pass, since both are valid resume candidates."""
+    if os.path.isdir(path):
+        try:
+            with open(os.path.join(path, _MANIFEST)) as f:
+                manifest = json.load(f)
+            if manifest.get("format") != _MANIFEST_FORMAT:
+                return False
+            for name, meta in manifest.get("entries", {}).items():
+                p = os.path.join(path, name)
+                if not os.path.isfile(p) or \
+                        os.path.getsize(p) != int(meta["size"]):
+                    return False
+                h = hashlib.sha256()
+                with open(p, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                if h.hexdigest() != meta["sha256"]:
+                    return False
+            return True
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+    try:
+        if os.path.getsize(path) == 0:
+            return False
+        with open(path, "rb") as f:
+            head = f.read(1)
+        return head in (b"\x80", b"{")
+    except OSError:
+        return False
+
+
+def read_checkpoint(path: str) -> dict:
+    """Load a checkpoint state dict from either format, verifying the
+    manifest for directory checkpoints (raises ValueError on corruption
+    — callers that want fallback catch it and try the next candidate)."""
+    if os.path.isdir(path):
+        if not validate_checkpoint(path):
+            raise ValueError(
+                f"checkpoint {path} failed manifest/checksum validation "
+                f"(truncated or corrupt)")
+        with open(os.path.join(path, _STATE_ENTRY), "rb") as f:
+            return pickle.load(f)
+    from ..framework.fs import open_for_read
+    with open_for_read(path, "rb") as f:
+        return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# public single-call API (SpmdTrainer.save/load, GPipeTrainer.save/load)
+# ---------------------------------------------------------------------------
+def save_trainer(trainer, path: str, extra: Optional[dict] = None,
+                 manifest: bool = False) -> str:
+    """Persist a trainer's full training state. manifest=True writes the
+    integrity-checked directory format (local paths only); the default
+    stays the legacy single pickle for drop-in compatibility (also the
+    only format that rides hdfs:// paths)."""
+    state = snapshot_trainer(trainer, extra=extra)
+    if manifest:
+        return write_checkpoint(state, path)
+    # fs backend (reference framework/io/fs.cc): local paths write
+    # fsync + tmp+rename (atomic — a killed save never corrupts), hdfs://
+    # paths stage locally and upload
+    from ..framework.fs import open_for_write
+    with open_for_write(path, "wb") as f:
+        pickle.dump(state, f)
+    return path
+
+
+def load_trainer(trainer, path: str) -> dict:
+    """Restore a save_trainer checkpoint (either format) into a (re)built
+    trainer. Returns the 'extra' metadata dict."""
+    state = read_checkpoint(path)
+    if not isinstance(state, dict) or state.get("format") != _FORMAT:
+        raise ValueError(f"{path} is not a {_FORMAT} checkpoint")
+    return restore_trainer(trainer, state)
+
+
+def latest_checkpoint(directory: str, prefix: str = "ckpt-",
+                      validate: bool = True, gc_tmp: bool = True):
+    """Newest VALID `{prefix}{step}` entry in directory (auto-resume
+    lookup, reference AutoCheckpointChecker.get_range_checkpoint_path).
+
+    Candidates failing validate_checkpoint (truncated file, corrupt or
+    incomplete manifest dir) are skipped so resume lands on the newest
+    checkpoint that will actually load. Stale `.tmp` staging orphans
+    from crashed saves are garbage-collected (call sites are resume-time
+    — no writer is active; pass gc_tmp=False to scan read-only)."""
+    if gc_tmp:
+        gc_stale_tmps(directory, prefix)
+    for _, full in checkpoint_candidates(directory, prefix):
+        if not validate or validate_checkpoint(full):
+            return full
+    return None

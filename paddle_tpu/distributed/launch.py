@@ -238,6 +238,26 @@ def launch(args=None) -> int:
 
     ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
 
+    # preemption handling: SIGTERM on the launcher forwards to every
+    # trainer so their PreemptionGuards drain the in-flight step and
+    # checkpoint; the pod then exits with the trainers' status instead
+    # of elastic-restarting into a doomed relaunch
+    current_procs: List[TrainerProc] = []
+    preempted = [False]
+
+    def _forward_sigterm(signum, frame):
+        preempted[0] = True
+        print("launch: SIGTERM received; forwarding to trainers for "
+              "drain + checkpoint", file=sys.stderr, flush=True)
+        for t in current_procs:
+            if t.proc.poll() is None:
+                t.proc.terminate()
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_sigterm)
+    except ValueError:  # pragma: no cover (non-main thread)
+        prev_term = None
+
     attempts = a.elastic_retries + 1
     for attempt in range(attempts):
         # fresh ports each attempt: the dead pod's sockets may linger
@@ -277,16 +297,23 @@ def launch(args=None) -> int:
         procs = start_local_trainers(pod, len(endpoints), endpoints,
                                      coordinator, a.training_script,
                                      a.script_args, a.log_dir)
+        current_procs[:] = procs
         rc = watch_local_trainers(procs,
                                   heartbeat_dir=hb_dir,
                                   heartbeat_timeout=a.heartbeat_timeout)
-        if rc == 0:
-            return 0
+        if rc == 0 or preempted[0]:
+            # clean finish, or a preemption drain (trainers that
+            # checkpointed and exited 0 make the whole pod exit 0)
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+            return rc
         if attempt + 1 < attempts:
             print(f"launch: pod failed (rc={rc}); elastic restart "
                   f"{attempt + 2}/{attempts}", file=sys.stderr,
                   flush=True)
             time.sleep(1.0)
+    if prev_term is not None:
+        signal.signal(signal.SIGTERM, prev_term)
     return rc
 
 

@@ -1,0 +1,239 @@
+"""Fault-tolerant training primitives: async verified checkpoints +
+preemption handling.
+
+Production training stacks (Check-N-Run's decoupled, verified
+checkpointing; Orbax-style async snapshots) treat failure as the common
+case. This module gives paddle_tpu the same posture on top of
+distributed/checkpoint.py:
+
+- CheckpointManager: the training thread pays only the device->host
+  snapshot; serialization + checksum + atomic commit run on a
+  background thread. Keep-last-K GC, and resume that walks candidates
+  newest-first, skipping anything that fails manifest/checksum
+  validation — a truncated newest checkpoint falls back to the previous
+  valid one instead of killing the run.
+- PreemptionGuard: converts SIGTERM/SIGINT into a flag the training
+  loop polls, so the in-flight step drains, a final synchronous
+  checkpoint commits, and the process exits cleanly for the next launch
+  (elastic restart / auto-resume) to pick up.
+
+Reference analogue: fluid/incubate/checkpoint/auto_checkpoint.py kept
+epoch-granular snapshots keyed by env; here the unit is the compiled
+trainer's full state and the integrity story is explicit.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from .checkpoint import (checkpoint_candidates, gc_stale_tmps,
+                         latest_checkpoint, read_checkpoint,
+                         restore_trainer, snapshot_trainer,
+                         write_checkpoint)
+
+__all__ = ["CheckpointManager", "PreemptionGuard"]
+
+
+class CheckpointManager:
+    """Async, integrity-checked, keep-last-K trainer checkpoints.
+
+    save(trainer, step) snapshots device state to host on the calling
+    thread (the only part that must synchronize with training) and
+    commits the manifest directory `ckpt-{step}` on a background
+    thread. Saves are serialized: a new save first joins the previous
+    one, and any background failure is re-raised there — an I/O error
+    can delay training but never silently drop checkpoints.
+
+    restore_latest(trainer) restores the newest checkpoint that passes
+    validation, falling back across corrupt/truncated candidates.
+    """
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 async_save: bool = True, prefix: str = "ckpt-"):
+        if any(directory.startswith(s) for s in ("hdfs://", "afs://")):
+            raise NotImplementedError(
+                "CheckpointManager manages local directories; for "
+                "hdfs:// use save_trainer (single file) — its fs layer "
+                "already retries with backoff")
+        self.directory = directory
+        self.keep_last = max(1, int(keep_last))
+        self.async_save = bool(async_save)
+        self.prefix = prefix
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._saves = 0
+        self._fallbacks = 0
+        self.last_snapshot_ms: Optional[float] = None
+        self.last_commit_ms: Optional[float] = None
+
+    # -- write path --------------------------------------------------------
+    def _path_for(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}{int(step)}")
+
+    def save(self, trainer, step: Optional[int] = None,
+             extra: Optional[dict] = None, block: bool = False) -> str:
+        """Checkpoint `trainer` as `{prefix}{step}` (default: the
+        trainer's own step count). Returns the final path immediately;
+        with async_save the commit happens in the background — call
+        wait() (or the next save) to join it."""
+        self.wait()  # serialize saves + surface any background failure
+        if step is None:
+            step = getattr(trainer, "_step_count", 0)
+        path = self._path_for(step)
+        t0 = time.perf_counter()
+        state = snapshot_trainer(trainer, extra=extra)
+        self.last_snapshot_ms = (time.perf_counter() - t0) * 1e3
+
+        def commit():
+            t1 = time.perf_counter()
+            write_checkpoint(state, path)
+            self._gc()
+            self.last_commit_ms = (time.perf_counter() - t1) * 1e3
+
+        self._saves += 1
+        if self.async_save and not block:
+            def run():
+                try:
+                    commit()
+                except BaseException as e:  # surfaced by wait()
+                    self._error = e
+            self._thread = threading.Thread(
+                target=run, name="ckpt-writer", daemon=True)
+            self._thread.start()
+        else:
+            commit()
+        return path
+
+    def wait(self):
+        """Join the in-flight background save; re-raise its failure."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _candidates(self):
+        """(step, path) pairs, newest first, committed finals only."""
+        return checkpoint_candidates(self.directory, self.prefix)
+
+    def _gc(self):
+        """Keep the newest keep_last checkpoints; drop older ones and
+        any stale .tmp staging orphans from crashed saves."""
+        import shutil
+        for _, path in self._candidates()[self.keep_last:]:
+            try:
+                shutil.rmtree(path) if os.path.isdir(path) \
+                    else os.remove(path)
+            except OSError:
+                pass
+        gc_stale_tmps(self.directory, self.prefix)
+
+    # -- read path ---------------------------------------------------------
+    def latest(self, validate: bool = True) -> Optional[str]:
+        """Path of the newest valid checkpoint (no restore)."""
+        self.wait()
+        return latest_checkpoint(self.directory, prefix=self.prefix,
+                                 validate=validate, gc_tmp=False)
+
+    def restore_latest(self, trainer) -> Optional[dict]:
+        """Restore the newest checkpoint that validates AND unpickles,
+        falling back to older ones past corruption. Returns the saved
+        'extra' dict, or None when no usable checkpoint exists.
+
+        A structural mismatch against the live trainer (wrong model)
+        still raises — that is a configuration error, not bitrot."""
+        self.wait()
+        for _, path in self._candidates():
+            try:
+                # read_checkpoint validates the manifest itself — one
+                # read + one sha256 pass per candidate, not two
+                state = read_checkpoint(path)
+            except Exception as e:
+                self._fallbacks += 1
+                print(f"resilience: skipping corrupt checkpoint {path} "
+                      f"({type(e).__name__}: {e}); falling back",
+                      file=sys.stderr, flush=True)
+                continue
+            return restore_trainer(trainer, state)
+        return None
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "saves": self._saves,
+            "fallbacks": self._fallbacks,
+            "async": self.async_save,
+            "keep_last": self.keep_last,
+            "last_snapshot_ms": self.last_snapshot_ms,
+            "last_commit_ms": self.last_commit_ms,
+        }
+
+
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a poll-able flag so training loops
+    drain the in-flight step and checkpoint before exiting.
+
+    Usage:
+        guard = PreemptionGuard().install()
+        try:
+            for batch in loader:
+                trainer.train_step(*batch)
+                if guard.preempted:
+                    manager.save(trainer, block=True)
+                    break
+        finally:
+            guard.uninstall()
+
+    A second signal while draining falls through to the previous
+    handler (default: terminate) so a stuck drain can still be killed.
+    Installation is a no-op off the main thread (Python restricts
+    signal.signal to it) — preempted just stays False there.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self.signum: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        if self._event.is_set():
+            # second delivery: restore + re-raise so the default action
+            # (or the launcher's handler) runs — no infinite drain
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self.signum = signum
+        self._event.set()
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # pragma: no cover (signals need the main thread)
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev = {}
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

@@ -26,6 +26,7 @@ or fail loudly (round-1 verdict: silent flags are worse than errors).
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -110,7 +111,8 @@ class SpmdTrainer:
                  mesh: Optional[Mesh] = None,
                  strategy: Optional[DistributedStrategy] = None,
                  dp_axis: str = "dp", sp_axis: Optional[str] = None,
-                 donate: bool = True):
+                 donate: bool = True,
+                 anomaly_policy: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -164,6 +166,9 @@ class SpmdTrainer:
             "incr_every_n_steps": int(ac.get("incr_every_n_steps", 1000)),
             "decr_every_n_nan_or_inf": int(
                 ac.get("decr_every_n_nan_or_inf", 2)),
+            # floor for repeated non-finite streaks: dynamic scaling can
+            # halve only down to this, never to a denormal/zero scale
+            "min_loss_scaling": float(ac.get("min_loss_scaling", 1.0)),
         }
         if self.fp16_scaling and self.k_steps > 1:
             raise NotImplementedError(
@@ -177,6 +182,53 @@ class SpmdTrainer:
         # the flag changes the compiled program.
         from ..core.flags import GLOBAL_FLAGS
         self._check_nan_inf = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+
+        # ---- anomaly policy (resilience): what a non-finite loss/grad
+        # does to the step.  "raise" keeps the historical behavior (the
+        # nan guard above, only when FLAGS_check_nan_inf is on);
+        # "skip" compiles the fp16 scaler's sel(new, old) machinery into
+        # the fp32/bf16 step — the bad batch's update is discarded and an
+        # on-device counter records it; "rollback" restores the last-good
+        # host snapshot and skips the offending batch (host-side, costs
+        # one sync per step + a snapshot every rollback_every good steps).
+        self.anomaly_policy = (anomaly_policy or
+                               os.environ.get("PADDLE_TPU_ANOMALY_POLICY")
+                               or "raise")
+        if self.anomaly_policy not in ("raise", "skip", "rollback"):
+            raise ValueError(
+                f"anomaly_policy must be raise|skip|rollback, got "
+                f"{self.anomaly_policy!r}")
+        if self.anomaly_policy == "rollback" and (
+                self.fp16_scaling or self.k_steps > 1):
+            raise NotImplementedError(
+                "anomaly_policy='rollback' is not supported with fp16 "
+                "loss scaling or gradient_merge; use 'skip' (fp16 "
+                "already skips overflowed steps)")
+        if self.anomaly_policy != "raise":
+            # the policy owns non-finite handling; the raise-only guard
+            # would defeat it
+            self._check_nan_inf = False
+        # fp16's scaler already implements skip; the explicit anomaly
+        # state drives the fp32/bf16 paths
+        self._anom_skip = (self.anomaly_policy == "skip" and
+                           not self.fp16_scaling)
+        self._anom_rollback = self.anomaly_policy == "rollback"
+        if self._anom_rollback:
+            # rollback must be able to re-materialize state from its
+            # host snapshot at any step; donated buffers + the extra
+            # anomaly-vec output mis-alias on cache-deserialized CPU
+            # executables (observed: NaN leaking into params two steps
+            # after a rollback). The policy already pays a host sync per
+            # step — keeping inputs un-donated is the cheap, safe choice.
+            self._donate = False
+        self._rollback_count = 0
+        self._rollback_every = int(os.environ.get(
+            "PADDLE_TPU_ROLLBACK_EVERY", "1"))
+        self._last_good = None
+        # deterministic chaos: poison grads with NaN at step k (compiled
+        # into the step; see testing/faults.py)
+        from ..testing import faults as _faults
+        self._fault_nan_step = _faults.nan_poison_step()
 
         if st.recompute:
             # model must cooperate (wrap blocks in distributed.recompute);
@@ -298,6 +350,21 @@ class SpmdTrainer:
             }
             self._scaler_shardings = {k: self._repl
                                       for k in self._scaler_state}
+
+        # anomaly-skip state lives on-device like the fp16 scaler state:
+        # `t` is the optimizer-visible step count (does NOT advance on
+        # skipped steps, so Adam bias correction matches a run that never
+        # saw the bad batch), `skipped` counts discarded updates
+        self._anomaly_state = None
+        if self._anom_skip:
+            self._anomaly_state = {
+                "t": jax.device_put(jnp.asarray(self._step_count,
+                                                jnp.int32), self._repl),
+                "skipped": jax.device_put(jnp.asarray(0, jnp.int32),
+                                          self._repl),
+            }
+            self._anomaly_shardings = {k: self._repl
+                                       for k in self._anomaly_state}
 
         # gradient-merge buffer (reference GradMergeAllReduceOpHandle /
         # gradient_merge_optimizer.py): ZeRO stage>=2 shards it over dp
@@ -437,35 +504,134 @@ class SpmdTrainer:
                 f"FLAGS_check_nan_inf: nan/inf detected in compiled "
                 f"train step: {names}")
 
+    def _poison_grads(self, grads, step_no):
+        """Fault injection (PADDLE_FAULT_NAN_STEP): NaN every floating
+        gradient on the armed step. No-op (and nothing compiled in)
+        unless armed at trainer build time."""
+        k = self._fault_nan_step
+        if k is None:
+            return grads
+        return {n: jnp.where(jnp.asarray(step_no) == k,
+                             jnp.full_like(g, jnp.nan), g)
+                if _is_floating(g) else g for n, g in grads.items()}
+
+    def _nonfinite_any(self, loss, grads):
+        """Scalar bool: loss or any trainable floating grad is nan/inf
+        (the skip/rollback policies' trigger)."""
+        checks = [jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                  for n, g in grads.items()
+                  if self._trainable[n] and _is_floating(g)]
+        ok = jnp.stack(checks).all() if checks else jnp.asarray(True)
+        return (~jnp.isfinite(loss)) | (~ok)
+
+    # ---- anomaly_policy='rollback' host machinery --------------------
+    def _capture_last_good(self):
+        """Host-RAM snapshot of the full in-memory training state (the
+        rollback target). Must OWN its memory (checkpoint._to_host):
+        a zero-copy view would be overwritten by the next donated step
+        and the 'last good' snapshot would track the live NaN state."""
+        from .checkpoint import _to_host
+        self._last_good = {
+            "params": _to_host(self.params),
+            "opt": _to_host(self.opt_state),
+            "buffers": _to_host(self.buffers),
+            "step": self._step_count,
+        }
+
+    def _restore_last_good(self):
+        # device_put of a host array can be ZERO-COPY on the CPU backend;
+        # hand it a private copy so the snapshot (which we must be able
+        # to restore again) never shares memory with donated live state
+        s = self._last_good
+        self.params = {
+            n: jax.device_put(a.copy(), self._param_shardings[n])
+            for n, a in s["params"].items()}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a, sh: jax.device_put(a.copy(), sh), s["opt"],
+            self._opt_shardings)
+        self.buffers = {
+            n: jax.device_put(a.copy(), self._buffer_shardings[n])
+            for n, a in s["buffers"].items()}
+        self._step_count = s["step"]
+        self.optimizer._step_count = s["step"]
+
+    def _handle_rollback(self, vec):
+        """Host side of anomaly_policy='rollback': on a non-finite step,
+        rewind to the last-good snapshot and skip the batch; on a good
+        step, refresh the snapshot every rollback_every steps."""
+        bad = np.asarray(vec).any()
+        if bad:
+            self._rollback_count += 1
+            self._restore_last_good()
+        elif self._step_count % self._rollback_every == 0:
+            self._capture_last_good()
+        return bad
+
     def _build_fused(self, n_inputs, n_labels, with_outputs=False):
         """Single-executable step: fwd+bwd+update (k_steps == 1).
         with_outputs additionally returns the forward outputs (hapi needs
         them for metrics; XLA computes them anyway)."""
         if self.fp16_scaling:
             return self._build_fused_fp16(n_inputs, n_labels, with_outputs)
+        anom_skip = self._anom_skip
+        want_vec = self._check_nan_inf or self._anom_rollback
 
-        def step(params, opt_state, buffers, lr, step_no, *batch):
+        def step(params, opt_state, buffers, *rest):
+            if anom_skip:
+                anom, lr, step_no = rest[0], rest[1], rest[2]
+                batch = rest[3:]
+            else:
+                anom, (lr, step_no) = None, rest[:2]
+                batch = rest[2:]
             inputs, labels = batch[:n_inputs], batch[n_inputs:]
             loss, new_buffers, grads, outs = self._grads_fn(
                 params, buffers, inputs, labels, want_outputs=with_outputs)
-            new_params, new_opt = self._apply(
-                params, opt_state, grads, lr, step_no)
+            grads = self._poison_grads(grads, step_no)
+            if anom_skip:
+                # fp16-style skip for fp32/bf16: discard the bad batch's
+                # update via a scalar select, advance the optimizer step
+                # only on finite steps (Adam bias correction parity with
+                # a run that never saw the batch)
+                bad = self._nonfinite_any(loss, grads)
+                t = jnp.where(bad, anom["t"], anom["t"] + 1)
+                new_params_u, new_opt_u = self._apply(
+                    params, opt_state, grads, lr, t)
+
+                def sel(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(bad, b, a), new, old)
+
+                new_params = sel(new_params_u, params)
+                new_opt = sel(new_opt_u, opt_state)
+                new_anom = {"t": t.astype(jnp.int32),
+                            "skipped": (anom["skipped"] +
+                                        bad.astype(jnp.int32))}
+            else:
+                new_params, new_opt = self._apply(
+                    params, opt_state, grads, lr, step_no)
+                new_anom = None
             merged = dict(buffers)
             merged.update(new_buffers)
-            extra = (self._nanguard_vec(loss, grads),) \
-                if self._check_nan_inf else ()
+            out = (new_params, new_opt, merged, loss)
+            if anom_skip:
+                out = out + (new_anom,)
             if with_outputs:
-                return (new_params, new_opt, merged, loss, outs) + extra
-            return (new_params, new_opt, merged, loss) + extra
+                out = out + (outs,)
+            if want_vec:
+                out = out + (self._nanguard_vec(loss, grads),)
+            return out
 
-        donate = (0, 1, 2) if self._donate else ()
+        donate = ((0, 1, 2, 3) if anom_skip else (0, 1, 2)) \
+            if self._donate else ()
         # input shardings come from the committed input arrays (device_put
         # in __init__/shard_batch); out_shardings pin the state placement
         shardings = (self._param_shardings, self._opt_shardings,
                      self._buffer_shardings, self._repl)
+        if anom_skip:
+            shardings = shardings + (dict(self._anomaly_shardings),)
         if with_outputs:
             shardings = shardings + (None,)  # outputs: let GSPMD place
-        if self._check_nan_inf:
+        if want_vec:
             shardings = shardings + (self._repl,)
         return jax.jit(step, out_shardings=shardings,
                        donate_argnums=donate)
@@ -492,6 +658,7 @@ class SpmdTrainer:
             loss, new_buffers, grads, outs = self._grads_fn(
                 params, buffers, inputs, labels,
                 want_outputs=with_outputs, scale=scale)
+            grads = self._poison_grads(grads, step_no)
             inv = (jnp.asarray(1.0, jnp.float32) / scale)
             grads = {n: g * inv.astype(g.dtype) if _is_floating(g) else g
                      for n, g in grads.items()}
@@ -525,7 +692,8 @@ class SpmdTrainer:
             new_scale = jnp.where(incr, grown, scale)
             new_scale = jnp.where(
                 decr, jnp.maximum(scale * cfg["decr_ratio"],
-                                  jnp.asarray(1.0, jnp.float32)),
+                                  jnp.asarray(cfg["min_loss_scaling"],
+                                              jnp.float32)),
                 new_scale)
             good = jnp.where(incr, jnp.asarray(0, jnp.int32), good)
             bad = jnp.where(decr, jnp.asarray(0, jnp.int32), bad)
@@ -560,20 +728,46 @@ class SpmdTrainer:
                        donate_argnums=donate)
 
     def _build_accum(self, n_inputs, n_labels):
-        def accum(params, grad_buf, buffers, *batch):
+        anom_skip = self._anom_skip
+
+        def accum(params, grad_buf, buffers, *rest):
+            if anom_skip:
+                anom, batch = rest[0], rest[1:]
+            else:
+                anom, batch = None, rest
             inputs, labels = batch[:n_inputs], batch[n_inputs:]
             loss, new_buffers, grads, _ = self._grads_fn(
                 params, buffers, inputs, labels)
-            new_buf = {n: grad_buf[n] + grads[n] for n in grad_buf}
+            if anom_skip:
+                # a poisoned micro-batch is dropped from the window (its
+                # grads never enter the merge buffer); the window-end
+                # update still divides by k_steps — skip under gradient
+                # merge trades a slightly small update for survival
+                bad = self._nonfinite_any(loss, grads)
+                new_buf = {n: jnp.where(bad, grad_buf[n],
+                                        grad_buf[n] + grads[n])
+                           for n in grad_buf}
+                new_anom = {"t": anom["t"],
+                            "skipped": (anom["skipped"] +
+                                        bad.astype(jnp.int32))}
+            else:
+                new_buf = {n: grad_buf[n] + grads[n] for n in grad_buf}
+                new_anom = None
             merged = dict(buffers)
             merged.update(new_buffers)
-            extra = (self._nanguard_vec(loss, grads),) \
-                if self._check_nan_inf else ()
-            return (new_buf, merged, loss) + extra
+            out = (new_buf, merged, loss)
+            if anom_skip:
+                out = out + (new_anom,)
+            if self._check_nan_inf:
+                out = out + (self._nanguard_vec(loss, grads),)
+            return out
 
-        donate = (1, 2) if self._donate else ()
+        donate = ((1, 2, 3) if anom_skip else (1, 2)) \
+            if self._donate else ()
         shardings = (self._grad_shardings, self._buffer_shardings,
                      self._repl)
+        if anom_skip:
+            shardings = shardings + (dict(self._anomaly_shardings),)
         if self._check_nan_inf:
             shardings = shardings + (self._repl,)
         return jax.jit(accum, out_shardings=shardings,
@@ -635,6 +829,8 @@ class SpmdTrainer:
                 self._compiled[key] = self._build_fused(
                     len(inputs), len(labels), with_outputs=return_outputs)
             step_no = jnp.asarray(self._step_count + 1, jnp.int32)
+            if self._anom_rollback and self._last_good is None:
+                self._capture_last_good()  # rollback target before step 1
             # the ambient mesh lets layers place sharding constraints on
             # intermediates (MoE dispatch buffers) while jit traces
             with compile_mesh_guard(self.mesh):
@@ -642,28 +838,36 @@ class SpmdTrainer:
                     res = self._compiled[key](
                         self.params, self.opt_state, self.buffers,
                         self._scaler_state, lr, step_no, *batch)
+                elif self._anom_skip:
+                    res = self._compiled[key](
+                        self.params, self.opt_state, self.buffers,
+                        self._anomaly_state, lr, step_no, *batch)
                 else:
                     res = self._compiled[key](
                         self.params, self.opt_state, self.buffers, lr,
                         step_no, *batch)
             res = list(res)
-            guard = res.pop() if self._check_nan_inf else None
-            if self.fp16_scaling and return_outputs:
-                (self.params, self.opt_state, self.buffers, loss,
-                 self._scaler_state, outs) = res
-            elif self.fp16_scaling:
+            guard = res.pop() \
+                if (self._check_nan_inf or self._anom_rollback) else None
+            outs = res.pop() if return_outputs else None
+            if self.fp16_scaling:
                 (self.params, self.opt_state, self.buffers, loss,
                  self._scaler_state) = res
-            elif return_outputs:
+            elif self._anom_skip:
                 (self.params, self.opt_state, self.buffers, loss,
-                 outs) = res
+                 self._anomaly_state) = res
             else:
                 self.params, self.opt_state, self.buffers, loss = res
             self._step_count += 1
             self.optimizer._step_count = self._step_count
-            if guard is not None:
+            if self._anom_rollback:
+                # one host sync per step — the policy's documented price
+                self._handle_rollback(guard)
+            elif guard is not None:
                 self._raise_nonfinite(
                     guard, names=["loss"] if self.fp16_scaling else None)
+            from ..testing import faults as _faults
+            _faults.maybe_sigterm(self._step_count)
             return (loss, outs) if return_outputs else loss
         if return_outputs:
             raise NotImplementedError(
@@ -677,13 +881,19 @@ class SpmdTrainer:
         if "update" not in self._compiled:
             self._compiled["update"] = self._build_update()
         with compile_mesh_guard(self.mesh):
-            res = self._compiled[akey](
-                self.params, self._grad_buf, self.buffers, *batch)
-        if self._check_nan_inf:
-            self._grad_buf, self.buffers, loss, guard = res
+            if self._anom_skip:
+                res = self._compiled[akey](
+                    self.params, self._grad_buf, self.buffers,
+                    self._anomaly_state, *batch)
+            else:
+                res = self._compiled[akey](
+                    self.params, self._grad_buf, self.buffers, *batch)
+        res = list(res)
+        guard = res.pop() if self._check_nan_inf else None
+        if self._anom_skip:
+            self._grad_buf, self.buffers, loss, self._anomaly_state = res
         else:
             self._grad_buf, self.buffers, loss = res
-            guard = None
         self._step_count += 1
         if guard is not None:
             self._raise_nonfinite(guard)
@@ -695,6 +905,8 @@ class SpmdTrainer:
                     self.params, self.opt_state, self._grad_buf, lr,
                     step_no)
             self.optimizer._step_count = self._step_count // self.k_steps
+        from ..testing import faults as _faults
+        _faults.maybe_sigterm(self._step_count)
         return loss
 
     def eval_step(self, inputs):
@@ -742,16 +954,20 @@ class SpmdTrainer:
         sd.update({n: Tensor(a) for n, a in self.buffers.items()})
         return sd
 
-    def save(self, path: str, extra=None) -> str:
+    def save(self, path: str, extra=None, manifest: bool = False) -> str:
         """Checkpoint the full training state (params + opt state + step
-        + LR scheduler [+ grad-merge buffer]) — reference
-        auto_checkpoint.py:71 / fleet.save_persistables."""
+        + LR scheduler [+ grad-merge buffer, scaler, anomaly counters]) —
+        reference auto_checkpoint.py:71 / fleet.save_persistables.
+        manifest=True writes the integrity-checked directory format
+        (sha256-verified on load; see distributed/resilience.py for the
+        async keep-last-K manager built on it)."""
         from .checkpoint import save_trainer
-        return save_trainer(self, path, extra=extra)
+        return save_trainer(self, path, extra=extra, manifest=manifest)
 
     def load(self, path: str) -> dict:
-        """Restore a save() checkpoint; shardings are re-applied from
-        THIS trainer, so the mesh layout may differ from the writer's."""
+        """Restore a save() checkpoint (single-file or manifest dir);
+        shardings are re-applied from THIS trainer, so the mesh layout
+        may differ from the writer's."""
         from .checkpoint import load_trainer
         return load_trainer(self, path)
 
@@ -765,11 +981,12 @@ class SpmdTrainer:
         """
         import pickle
         from jax import export as jexport
-        if self.fp16_scaling or self._check_nan_inf:
+        if self.fp16_scaling or self._check_nan_inf or \
+                self.anomaly_policy != "raise":
             raise NotImplementedError(
                 "export_train_step supports the standard bf16/fp32 step "
-                "(no fp16 scaler state, no nan guard) for a stable "
-                "serialized signature")
+                "(no fp16 scaler state, no nan guard, no anomaly policy) "
+                "for a stable serialized signature")
         inputs = example_inputs if isinstance(example_inputs,
                                               (tuple, list)) \
             else (example_inputs,)
@@ -811,6 +1028,23 @@ class SpmdTrainer:
         with open(path + ".pdtrainstate", "wb") as f:
             pickle.dump(state, f, protocol=4)
         return path
+
+    @property
+    def stats(self) -> dict:
+        """Resilience counters for logging/bench: the active anomaly
+        policy plus how many updates it discarded (skip: on-device
+        counter; fp16: steps whose optimizer-visible count did not
+        advance; rollback: host rewinds)."""
+        s = {"anomaly_policy": self.anomaly_policy,
+             "rollback_steps": self._rollback_count}
+        if self._anomaly_state is not None:
+            s["skipped_steps"] = int(self._anomaly_state["skipped"])
+        elif self.fp16_scaling and self._scaler_state is not None:
+            s["skipped_steps"] = int(
+                self._step_count - int(self._scaler_state["t"]))
+        else:
+            s["skipped_steps"] = 0
+        return s
 
     @property
     def loss_scale(self):

@@ -9,42 +9,128 @@ through the hadoop CLI, everything else is the local filesystem.  Save
 paths stage through a local temp file and upload (the reference's
 _put-on-close pattern), loads download to a temp file first — so the
 pickle/np machinery only ever sees local files.
+
+Robustness posture (production training treats I/O failure as the
+common case):
+- every write is flush+fsync'd BEFORE the atomic rename, so a crash can
+  never commit a zero-length or partially-written file;
+- LocalFS.put survives EXDEV (tmp and dest on different filesystems) by
+  falling back to copy + same-directory rename;
+- HadoopFS shell-outs and open_for_read/open_for_write retry with
+  exponential backoff + jitter (PADDLE_TPU_FS_RETRIES, default 3);
+- deterministic chaos via paddle_tpu.testing.faults (PADDLE_FAULT_FS).
 """
 from __future__ import annotations
 
+import errno
 import os
+import random
 import shutil
 import subprocess
 import tempfile
+import time
 from contextlib import contextmanager
 from typing import List
 
 __all__ = ["LocalFS", "HadoopFS", "get_fs", "open_for_write",
-           "open_for_read"]
+           "open_for_read", "retry_with_backoff", "fsync_file"]
+
+
+def _fault(op: str):
+    """Fault point — no-op unless PADDLE_FAULT_FS arms it."""
+    if os.environ.get("PADDLE_FAULT_FS"):
+        from ..testing import faults
+        faults.maybe_fail_fs(op)
+
+
+def fsync_file(f):
+    """Flush a file object's buffers all the way to stable storage."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    """Best-effort durability for a rename: fsync the containing
+    directory so the new directory entry survives a crash."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:  # pragma: no cover (exotic fs)
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def retry_with_backoff(fn, *, tries: int = None, base_ms: float = 50.0,
+                       max_ms: float = 5000.0, jitter: float = 0.25,
+                       retry_on=(OSError, subprocess.SubprocessError),
+                       desc: str = "fs op", sleep=time.sleep):
+    """Run fn() with exponential backoff + jitter on transient errors.
+
+    tries defaults to PADDLE_TPU_FS_RETRIES (3). The delay before
+    attempt k is min(max_ms, base_ms * 2**(k-1)) scaled by a random
+    factor in [1, 1+jitter] — the Check-N-Run-style posture that a
+    storage hiccup should cost a bounded wait, not the training run.
+    """
+    if tries is None:
+        tries = int(os.environ.get("PADDLE_TPU_FS_RETRIES", "3"))
+    tries = max(1, tries)
+    for attempt in range(tries):
+        try:
+            return fn()
+        except retry_on:
+            if attempt + 1 >= tries:
+                raise
+            delay = min(max_ms, base_ms * (2 ** attempt)) / 1000.0
+            delay *= 1.0 + random.random() * jitter
+            sleep(delay)
 
 
 class LocalFS:
     def exists(self, path: str) -> bool:
+        _fault("exists")
         return os.path.exists(path)
 
     def makedirs(self, path: str):
+        _fault("mkdir")
         if path:
             os.makedirs(path, exist_ok=True)
 
     def remove(self, path: str):
+        _fault("remove")
         if os.path.isdir(path):
             shutil.rmtree(path)
         elif os.path.exists(path):
             os.remove(path)
 
     def list_dir(self, path: str) -> List[str]:
+        _fault("list")
         return sorted(os.listdir(path))
 
     def put(self, local: str, dest: str):
+        _fault("put")
         self.makedirs(os.path.dirname(dest))
-        os.replace(local, dest)  # atomic on the same filesystem
+        try:
+            os.replace(local, dest)  # atomic on the same filesystem
+        except OSError as e:
+            if e.errno != errno.EXDEV:
+                raise
+            # tmp and dest sit on different filesystems (tmpfs staging
+            # dir + NFS checkpoint dir is the classic case): stage a
+            # copy NEXT TO dest so the final rename is same-fs atomic
+            tmp = dest + ".xdev.tmp"
+            with open(local, "rb") as src, open(tmp, "wb") as out:
+                shutil.copyfileobj(src, out)
+                fsync_file(out)
+            os.replace(tmp, dest)
+            os.remove(local)
+        _fsync_dir(os.path.dirname(dest))
 
     def get(self, src: str, local: str):
+        _fault("get")
         shutil.copyfile(src, local)
 
 
@@ -52,13 +138,14 @@ class HadoopFS:
     """`hadoop fs` CLI wrapper (fs.cc ran the same commands).
 
     The binary is taken from PADDLE_HADOOP_BIN (default "hadoop") so
-    tests and exotic installs can point at their own wrapper."""
+    tests and exotic installs can point at their own wrapper.  Every
+    command retries with backoff: a transient namenode hiccup costs a
+    bounded wait instead of the training run."""
 
     def __init__(self):
         self.bin = os.environ.get("PADDLE_HADOOP_BIN", "hadoop")
 
-    def _run(self, *args, check=True) -> subprocess.CompletedProcess:
-        cmd = [self.bin, "fs", *args]
+    def _run_once(self, cmd, check) -> subprocess.CompletedProcess:
         try:
             return subprocess.run(cmd, capture_output=True, text=True,
                                   check=check, timeout=300)
@@ -66,6 +153,17 @@ class HadoopFS:
             raise RuntimeError(
                 f"hadoop CLI {self.bin!r} not found; install hadoop or "
                 f"set PADDLE_HADOOP_BIN (needed for hdfs:// paths)")
+
+    def _run(self, *args, check=True) -> subprocess.CompletedProcess:
+        cmd = [self.bin, "fs", *args]
+
+        def attempt():
+            _fault("run")
+            return self._run_once(cmd, check)
+
+        # CalledProcessError/TimeoutExpired are SubprocessError; the
+        # RuntimeError for a missing binary is deliberately NOT retried
+        return retry_with_backoff(attempt, desc=f"hadoop {args[0]}")
 
     def exists(self, path: str) -> bool:
         return self._run("-test", "-e", path, check=False).returncode == 0
@@ -108,21 +206,35 @@ def get_fs(path: str):
 @contextmanager
 def open_for_write(path: str, mode: str = "wb"):
     """Yield a local file handle; on clean exit the bytes land at `path`
-    atomically (local: tmp+rename; remote: tmp+put)."""
+    atomically (local: fsync + tmp+rename; remote: fsync + tmp+put).
+    A crash mid-write leaves the destination untouched — the fsync
+    BEFORE the rename means a committed path can never be zero-length —
+    and an exception inside the block removes the temp file instead of
+    orphaning it."""
     fs = get_fs(path)
     if isinstance(fs, LocalFS):
+        _fault("open_write")
         d = os.path.dirname(path)
         fs.makedirs(d)
         tmp = path + ".tmp"
-        with open(tmp, mode) as f:
-            yield f
+        try:
+            with open(tmp, mode) as f:
+                yield f
+                fsync_file(f)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
         os.replace(tmp, path)
+        _fsync_dir(d)
     else:
+        _fault("open_write")
         fd, tmp = tempfile.mkstemp(suffix=".pdtmp")
         os.close(fd)
         try:
             with open(tmp, mode) as f:
                 yield f
+                fsync_file(f)
             fs.put(tmp, path)
         finally:
             if os.path.exists(tmp):
@@ -133,13 +245,15 @@ def open_for_write(path: str, mode: str = "wb"):
 def open_for_read(path: str, mode: str = "rb"):
     fs = get_fs(path)
     if isinstance(fs, LocalFS):
+        _fault("open_read")
         with open(path, mode) as f:
             yield f
     else:
+        _fault("open_read")
         fd, tmp = tempfile.mkstemp(suffix=".pdtmp")
         os.close(fd)
         try:
-            fs.get(path, tmp)
+            fs.get(path, tmp)  # retried inside HadoopFS._run
             with open(tmp, mode) as f:
                 yield f
         finally:

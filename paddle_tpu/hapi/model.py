@@ -21,6 +21,11 @@ from . import callbacks as cbks_mod
 __all__ = ["Model"]
 
 
+class _Preempted(Exception):
+    """Internal control flow: SIGTERM/SIGINT arrived, the in-flight step
+    drained and a checkpoint committed — unwind fit() cleanly."""
+
+
 def _to_list(x):
     if x is None:
         return []
@@ -36,9 +41,14 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self.preempted = False
         self._mesh = None
         self._strategy = None
         self._trainer = None
+        self._ckpt_manager = None
+        # monotonic train-batch counter across resumes (names the eager
+        # auto checkpoints so mid-epoch snapshots order correctly)
+        self._global_batch_count = 0
 
     # ---- setup ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -193,9 +203,15 @@ class Model:
             auto_resume=False):
         """reference hapi/model.py:1244. auto_resume=True (with
         save_dir) checkpoints the FULL training state under
-        save_dir/auto each save_freq epochs and, on restart, restores
-        the newest one and continues from the next epoch — the
-        reference's auto_checkpoint train_epoch_range semantics."""
+        save_dir/auto each save_freq epochs (asynchronously in compiled
+        mode, with per-entry checksums) and, on restart, restores the
+        newest VALID one — skipping truncated/corrupt snapshots — and
+        continues from the recorded epoch/step. While auto_resume is
+        active, SIGTERM/SIGINT drain the in-flight step, commit a final
+        synchronous checkpoint (mid-epoch position included) and return
+        cleanly, so the next launch resumes where the preemption hit —
+        the reference's auto_checkpoint train_epoch_range semantics
+        hardened for preemptible fleets."""
         train_loader = self._as_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._as_loader(eval_data, batch_size, False, False,
@@ -206,53 +222,110 @@ class Model:
             steps=self._try_len(train_loader), log_freq=log_freq,
             save_freq=save_freq, save_dir=save_dir, verbose=verbose,
             metrics=self._metrics_names())
-        start_epoch = 0
+        start_epoch, skip_steps = 0, 0
         auto_dir = os.path.join(save_dir, "auto") \
             if (auto_resume and save_dir) else None
+        guard = None
         if auto_dir:
-            start_epoch = self._auto_restore(auto_dir)
-        cbks.on_begin("train")
+            start_epoch, skip_steps = self._auto_restore(auto_dir)
+            from ..distributed.resilience import PreemptionGuard
+            guard = PreemptionGuard().install()
         self.stop_training = False
-        for epoch in range(start_epoch, epochs):
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbks, "train",
-                                       accumulate_grad_batches, num_iters)
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          callbacks=None,
-                                          _inner_cbks=cbks)
-                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, str(epoch)))
-                if auto_dir:
-                    self._auto_save(auto_dir, epoch)
-            if self.stop_training:
-                break
-        if save_dir is not None:
-            self.save(os.path.join(save_dir, "final"))
-        cbks.on_end("train")
+        self.preempted = False
+        try:
+            cbks.on_begin("train")
+            for epoch in range(start_epoch, epochs):
+                cbks.on_epoch_begin(epoch)
+                try:
+                    logs = self._run_one_epoch(
+                        train_loader, cbks, "train",
+                        accumulate_grad_batches, num_iters,
+                        skip_steps=(skip_steps if epoch == start_epoch
+                                    else 0),
+                        guard=guard, epoch=epoch, auto_dir=auto_dir)
+                except _Preempted:
+                    self.preempted = True
+                    self.stop_training = True
+                    break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and \
+                        (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              callbacks=None,
+                                              _inner_cbks=cbks)
+                    logs.update({"eval_" + k: v
+                                 for k, v in eval_logs.items()})
+                if save_dir is not None and (epoch + 1) % save_freq == 0:
+                    self.save(os.path.join(save_dir, str(epoch)))
+                    if auto_dir:
+                        self._auto_save(auto_dir, epoch)
+                if self.stop_training:
+                    break
+            if save_dir is not None and not self.preempted:
+                self.save(os.path.join(save_dir, "final"))
+            cbks.on_end("train")
+        finally:
+            if guard is not None:
+                guard.uninstall()
+            if self._ckpt_manager is not None:
+                self._ckpt_manager.wait()
 
     # ---- auto checkpoint (reference auto_checkpoint.py:71) ---------------
     _AUTO_KEEP = 2  # retained snapshots (newest + one fallback)
 
+    def _ensure_ckpt_manager(self, auto_dir):
+        from ..distributed.resilience import CheckpointManager
+        if self._ckpt_manager is None or \
+                self._ckpt_manager.directory != auto_dir:
+            self._ckpt_manager = CheckpointManager(
+                auto_dir, keep_last=self._AUTO_KEEP)
+        return self._ckpt_manager
+
+    def _eager_marker(self, auto_dir, epoch, batch_step, weights):
+        """Eager-mode auto checkpoint: a JSON marker named by the global
+        batch counter (monotonic across resumes) pointing at saved
+        weights+optimizer state."""
+        import json
+        os.makedirs(auto_dir, exist_ok=True)
+        g = self._global_batch_count
+        tmp = os.path.join(auto_dir, f"ckpt-{g}.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "batch_step": batch_step,
+                       "global_step": g, "mode": "eager",
+                       "weights": weights}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(auto_dir, f"ckpt-{g}"))
+        self._auto_prune(auto_dir)
+
     def _auto_save(self, auto_dir, epoch):
         if self.compiled:
-            self._ensure_trainer().save(
-                os.path.join(auto_dir, f"ckpt-{epoch}"),
-                extra={"epoch": epoch})
+            # async manifest checkpoint: the training thread pays only
+            # the device->host snapshot; commit happens in the background
+            tr = self._ensure_trainer()
+            self._ensure_ckpt_manager(auto_dir).save(
+                tr, step=tr._step_count, extra={"epoch": epoch})
         else:
             # eager: fit already wrote save_dir/{epoch}.pdparams/.pdopt
             # one line earlier — the auto marker just points at it
-            import json
-            os.makedirs(auto_dir, exist_ok=True)
             weights = os.path.join(os.path.dirname(auto_dir), str(epoch))
-            tmp = os.path.join(auto_dir, f"ckpt-{epoch}.tmp")
-            with open(tmp, "w") as f:
-                json.dump({"epoch": epoch, "mode": "eager",
-                           "weights": weights}, f)
-            os.replace(tmp, os.path.join(auto_dir, f"ckpt-{epoch}"))
-        self._auto_prune(auto_dir)
+            self._eager_marker(auto_dir, epoch, None, weights)
+
+    def _preempt_save(self, auto_dir, epoch, step):
+        """Final synchronous checkpoint on SIGTERM/SIGINT, carrying the
+        mid-epoch position so resume skips the consumed batches."""
+        if auto_dir is None:
+            return
+        if self.compiled:
+            tr = self._ensure_trainer()
+            self._ensure_ckpt_manager(auto_dir).save(
+                tr, step=tr._step_count,
+                extra={"epoch": epoch, "batch_step": step}, block=True)
+        else:
+            weights = os.path.join(os.path.dirname(auto_dir),
+                                   f"preempt-{self._global_batch_count}")
+            self.save(weights)
+            self._eager_marker(auto_dir, epoch, step, weights)
 
     def _auto_prune(self, auto_dir):
         """Keep only the newest _AUTO_KEEP snapshots (the reference
@@ -267,30 +340,72 @@ class Model:
         for _, name in sorted(cks)[:-self._AUTO_KEEP]:
             os.remove(os.path.join(auto_dir, name))
 
-    def _auto_restore(self, auto_dir) -> int:
+    def _auto_restore(self, auto_dir):
+        """-> (start_epoch, skip_steps): restore the newest VALID auto
+        checkpoint (manifest/checksum-verified for compiled snapshots;
+        corrupt or truncated candidates fall back to the previous valid
+        one). skip_steps > 0 means the checkpoint was taken mid-epoch
+        (preemption): resume fast-forwards the loader past the batches
+        already consumed."""
         import json
         from ..distributed.checkpoint import latest_checkpoint
-        ck = latest_checkpoint(auto_dir)
+        # validate=False: this lookup only decides compiled-vs-eager
+        # from the candidate's TYPE; the actual restore below hashes and
+        # falls back itself, so a full sha256 pass here would be a
+        # redundant read of the whole checkpoint
+        ck = latest_checkpoint(auto_dir, validate=False)
         if ck is None:
-            return 0
-        with open(ck, "rb") as f:
-            is_pickle = f.read(1) == b"\x80"
-        if is_pickle != self.compiled:
+            return 0, 0
+        # compiled snapshots are manifest DIRECTORIES (or legacy pickle
+        # files); eager markers are JSON files
+        if os.path.isdir(ck):
+            ck_compiled = True
+        else:
+            with open(ck, "rb") as f:
+                ck_compiled = f.read(1) == b"\x80"
+        if ck_compiled != self.compiled:
             raise RuntimeError(
                 f"auto checkpoint {ck} was written in "
-                f"{'compiled' if is_pickle else 'eager'} mode but this "
+                f"{'compiled' if ck_compiled else 'eager'} mode but this "
                 f"run is {'compiled' if self.compiled else 'eager'}; "
                 f"prepare() with the same mesh/strategy as the "
                 f"interrupted run (or remove the auto/ directory)")
         if self.compiled:
-            extra = self._ensure_trainer().load(ck)
-            return int(extra.get("epoch", -1)) + 1
-        with open(ck) as f:
-            meta = json.load(f)
-        self.load(meta["weights"])
-        return int(meta["epoch"]) + 1
+            mgr = self._ensure_ckpt_manager(auto_dir)
+            extra = mgr.restore_latest(self._ensure_trainer())
+            if extra is None:
+                return 0, 0
+            epoch = int(extra.get("epoch", -1))
+            batch_step = extra.get("batch_step")
+            if batch_step is not None:
+                return epoch, int(batch_step) + 1
+            return epoch + 1, 0
+        # eager: walk markers newest-first so a marker whose weights
+        # vanished falls back instead of crashing
+        cands = []
+        for name in os.listdir(auto_dir):
+            if name.startswith("ckpt-") and not name.endswith(".tmp"):
+                try:
+                    cands.append((int(name[len("ckpt-"):]), name))
+                except ValueError:
+                    continue
+        for _, name in sorted(cands, reverse=True):
+            try:
+                with open(os.path.join(auto_dir, name)) as f:
+                    meta = json.load(f)
+                self.load(meta["weights"])
+            except (OSError, ValueError, KeyError):
+                continue
+            self._global_batch_count = int(meta.get("global_step", 0))
+            epoch = int(meta["epoch"])
+            batch_step = meta.get("batch_step")
+            if batch_step is not None:
+                return epoch, int(batch_step) + 1
+            return epoch + 1, 0
+        return 0, 0
 
-    def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None):
+    def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None,
+                       skip_steps=0, guard=None, epoch=0, auto_dir=None):
         from ..profiler import StepTimer
         logs = {}
         timer = StepTimer(warmup=1)
@@ -300,11 +415,17 @@ class Model:
         for step, batch in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
+            if mode == "train" and step < skip_steps:
+                # mid-epoch resume: these batches were consumed before
+                # the preemption checkpoint — fast-forward past them so
+                # the data order matches the uninterrupted run
+                continue
             cbks.on_batch_begin(mode, step, logs)
             ins, labs = self._split_batch(batch)
             update = (step + 1) % accum == 0
             if mode == "train":
                 out = self.train_batch(ins, labs, update=update)
+                self._global_batch_count += 1
             else:
                 out = self.eval_batch(ins, labs)
             if isinstance(out, tuple):
@@ -321,6 +442,11 @@ class Model:
                 # per-step wall time (reference profiler summary table)
                 logs["step_time_ms"] = round(timer.last_ms, 3)
             cbks.on_batch_end(mode, step, logs)
+            if mode == "train" and guard is not None and guard.preempted:
+                # the in-flight step has drained (train_batch returned):
+                # commit a final synchronous checkpoint and unwind
+                self._preempt_save(auto_dir, epoch, step)
+                raise _Preempted()
         return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -14,6 +14,7 @@ explicit stream code.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -133,17 +134,29 @@ class _MultiprocessIter:
     as a RuntimeError rather than a hang."""
 
     def __init__(self, loader, batch_lists, num_workers, capacity_bytes,
-                 timeout_ms, worker_init_fn=None):
+                 timeout_ms, worker_init_fn=None, worker_restarts=0):
         self.loader = loader
         self.batch_lists = batch_lists
         self.num_workers = num_workers
         self.capacity = capacity_bytes
         self.timeout_ms = timeout_ms
         self.worker_init_fn = worker_init_fn
+        # bounded revive budget PER WORKER for crash-style deaths (OOM
+        # kill, segfault): the replacement process resumes at the first
+        # batch the consumer has not received. Python-level dataset
+        # exceptions are NEVER retried — they are deterministic and the
+        # traceback is re-raised in the trainer instead.
+        self.worker_restarts = max(0, int(worker_restarts))
+
+    class _WorkerDied(Exception):
+        def __init__(self, w, seq, exitcode):
+            self.w, self.seq, self.exitcode = w, seq, exitcode
 
     def __iter__(self):
         import multiprocessing as mp
         import pickle
+        import tempfile
+        import traceback as tb_mod
 
         from .shm_ring import RingClosed, RingTimeout, ShmRing
 
@@ -152,9 +165,17 @@ class _MultiprocessIter:
         rings = [ShmRing.create(self.capacity) for _ in range(W)]
         ds, collate = self.loader.dataset, self.loader.collate_fn
         init_fn = self.worker_init_fn
+        # traceback spill files: the ring push of an error frame can
+        # itself fail (ring full, ring torn down); the file survives the
+        # worker so the consumer ALWAYS gets the real traceback instead
+        # of a bare "worker died" (the old path swallowed it)
+        err_dir = tempfile.mkdtemp(prefix="pd_dl_err_")
+        err_path = [os.path.join(err_dir, f"worker{w}.err")
+                    for w in range(W)]
 
         def work(w, ring_name, batches):
             ring = ShmRing.attach(ring_name)
+            done = 0
             try:
                 _set_worker_info(WorkerInfo(w, W, ds))
                 if init_fn is not None:
@@ -164,23 +185,36 @@ class _MultiprocessIter:
                         ("b", collate([ds[i] for i in idxs])),
                         protocol=pickle.HIGHEST_PROTOCOL)
                     ring.push(payload)
+                    done += 1
+                    from ..testing import faults as _faults
+                    _faults.maybe_kill_worker(w, done)
             except Exception:
-                import traceback
+                trace = tb_mod.format_exc()
                 try:
-                    ring.push(pickle.dumps(
-                        ("e", traceback.format_exc())))
+                    with open(err_path[w], "w") as f:
+                        f.write(trace)
+                except OSError:
+                    pass
+                try:
+                    ring.push(pickle.dumps(("e", trace)))
                 except Exception:
                     pass
             finally:
                 ring.close_writer()
 
-        procs = [ctx.Process(target=work,
-                             args=(w, rings[w].name,
-                                   self.batch_lists[w::W]),
-                             daemon=True)
-                 for w in range(W)]
-        for p in procs:
+        def spawn(w, skip):
+            """Start (or restart) worker w at its skip-th batch."""
+            p = ctx.Process(target=work,
+                            args=(w, rings[w].name,
+                                  self.batch_lists[w::W][skip:]),
+                            daemon=True)
             p.start()
+            return p
+
+        produced = [0] * W       # batches the CONSUMER popped per worker
+        revives = [self.worker_restarts] * W
+        procs = [spawn(w, 0) for w in range(W)]
+
         def pop_watched(seq):
             """Pop with liveness polling: a SIGKILLed worker (OOM) never
             runs close_writer, so an unbounded pop would hang silently —
@@ -200,27 +234,59 @@ class _MultiprocessIter:
                         # ring once more before declaring it dead
                         try:
                             return rings[w].pop(timeout_ms=100)
-                        except RingTimeout:
-                            raise RuntimeError(
-                                f"dataloader worker {w} died before "
-                                f"producing batch {seq} (exitcode "
-                                f"{procs[w].exitcode})")
-                    if deadline and _time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"dataloader worker {w} timed out")
+                        except (RingTimeout, RingClosed):
+                            raise self._WorkerDied(
+                                w, seq, procs[w].exitcode)
+                except RingClosed:
+                    raise self._WorkerDied(w, seq, procs[w].exitcode)
+                if deadline and _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"dataloader worker {w} timed out")
+
+        def worker_error(w):
+            """Spilled traceback from worker w, if it recorded one."""
+            try:
+                with open(err_path[w]) as f:
+                    return f.read().strip() or None
+            except OSError:
+                return None
+
+        def revive_or_raise(dead):
+            w = dead.w
+            trace = worker_error(w)
+            if trace is not None:
+                # deterministic dataset/collate exception: re-raise the
+                # captured traceback, do not burn a restart on it
+                raise RuntimeError(
+                    f"dataloader worker {w} failed:\n{trace}")
+            if revives[w] <= 0:
+                raise RuntimeError(
+                    f"dataloader worker {w} died before producing batch "
+                    f"{dead.seq} (exitcode {dead.exitcode}, "
+                    f"{self.worker_restarts} restart(s) exhausted)")
+            revives[w] -= 1
+            procs[w].join(5)
+            # the old ring may hold frames the consumer never popped (or
+            # a half-written frame from the kill): replace it wholesale
+            # and re-produce from the consumer's high-water mark
+            rings[w].destroy()
+            rings[w] = ShmRing.create(self.capacity)
+            procs[w] = spawn(w, produced[w])
 
         try:
             for seq in range(len(self.batch_lists)):
-                try:
-                    kind, payload = pickle.loads(pop_watched(seq))
-                except RingClosed:
-                    raise RuntimeError(
-                        f"dataloader worker {seq % W} exited before "
-                        f"producing batch {seq} (exitcode "
-                        f"{procs[seq % W].exitcode})")
+                w = seq % W
+                while True:
+                    try:
+                        raw = pop_watched(seq)
+                        break
+                    except self._WorkerDied as dead:
+                        revive_or_raise(dead)
+                kind, payload = pickle.loads(raw)
                 if kind == "e":
                     raise RuntimeError(
-                        f"dataloader worker {seq % W} failed:\n{payload}")
+                        f"dataloader worker {w} failed:\n{payload}")
+                produced[w] += 1
                 yield payload
         finally:
             for p in procs:
@@ -230,6 +296,8 @@ class _MultiprocessIter:
                 p.join(5)
             for r in rings:
                 r.destroy()
+            import shutil
+            shutil.rmtree(err_dir, ignore_errors=True)
 
 
 class DataLoader:
@@ -238,11 +306,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, shm_ring_capacity=32 << 20):
+                 persistent_workers=False, shm_ring_capacity=32 << 20,
+                 worker_restarts=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        # bounded revive budget for crashed (not failed) workers; the
+        # env default keeps launch configs out of user code
+        if worker_restarts is None:
+            worker_restarts = int(os.environ.get(
+                "PADDLE_TPU_WORKER_RESTARTS", "0"))
+        self.worker_restarts = max(0, int(worker_restarts))
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = prefetch_factor
         self.use_shared_memory = use_shared_memory
@@ -323,7 +398,7 @@ class DataLoader:
                 self, list(self.batch_sampler), self.num_workers,
                 self.shm_ring_capacity,
                 int(self.timeout * 1000) if self.timeout else -1,
-                self.worker_init_fn)
+                self.worker_init_fn, worker_restarts=self.worker_restarts)
             for collated in mp_iter:
                 yield self._to_tensors(collated)
         elif self.num_workers > 0 and self.use_buffer_reader:

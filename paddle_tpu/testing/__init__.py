@@ -1,0 +1,12 @@
+"""Test/chaos utilities — deterministic fault injection for the
+resilience stack (distributed/resilience.py).
+
+Production code calls the `faults` hooks at well-defined fault points
+(filesystem ops, gradient computation, dataloader workers, the train
+step); the hooks are no-ops unless the matching PADDLE_FAULT_* env var
+is set, so the hot path pays one cached env lookup.
+"""
+from . import faults  # noqa: F401
+from .faults import InjectedFault  # noqa: F401
+
+__all__ = ["faults", "InjectedFault"]

@@ -1,0 +1,140 @@
+"""Deterministic fault injection, env/flag driven.
+
+Each injector reads its PADDLE_FAULT_* variable lazily so subprocesses
+(dataloader workers, launched trainers) inherit the fault plan through
+the environment, and tests can monkeypatch it per-case. All counters are
+process-local and 1-indexed, making every fault reproducible: "the 3rd
+`put` fails" means the same call in every run.
+
+Supported faults
+----------------
+PADDLE_FAULT_FS="op:nth[:count][,op2:nth2...]"
+    Fail the nth (.. nth+count-1) invocation of the named filesystem op
+    with InjectedFault (an OSError, so the retry/backoff machinery in
+    framework/fs.py treats it like a transient HDFS hiccup). `op` is one
+    of put/get/exists/mkdir/remove/list/open_read/open_write/run, or "*"
+    to match any op (matched against a shared counter).
+PADDLE_FAULT_NAN_STEP="k"
+    SpmdTrainer poisons every gradient with NaN on train step k
+    (1-indexed, compiled in-graph so it works under jit/donation).
+PADDLE_FAULT_WORKER_KILL="w:after_n"
+    Multiprocess DataLoader worker w calls os._exit(137) after
+    producing after_n batches — a SIGKILL-like crash (no close_writer,
+    no traceback) that exercises death detection + bounded restart.
+PADDLE_FAULT_SIGTERM_STEP="k"
+    The training process sends itself SIGTERM right after train step k
+    completes — a deterministic preemption for kill-and-resume tests.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+__all__ = ["InjectedFault", "maybe_fail_fs", "nan_poison_step",
+           "maybe_kill_worker", "maybe_sigterm", "reset"]
+
+
+class InjectedFault(IOError):
+    """Raised by an armed fault point (subclasses IOError so fs-level
+    retry logic treats injected faults like real transient I/O errors).
+    """
+
+
+_lock = threading.Lock()
+_fs_counts: dict = {}
+_sigterm_fired = False
+
+
+def reset():
+    """Clear all injection counters (tests call this between cases)."""
+    global _sigterm_fired
+    with _lock:
+        _fs_counts.clear()
+        _sigterm_fired = False
+
+
+def _parse_fs_spec(spec: str):
+    """-> list of (op, first, last) windows (1-indexed, inclusive)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            continue
+        op = bits[0]
+        try:
+            first = int(bits[1])
+            count = int(bits[2]) if len(bits) > 2 else 1
+        except ValueError:
+            continue
+        out.append((op, first, first + count - 1))
+    return out
+
+
+def maybe_fail_fs(op: str):
+    """Fault point for filesystem operations: raises InjectedFault when
+    PADDLE_FAULT_FS arms this (op, call-ordinal)."""
+    spec = os.environ.get("PADDLE_FAULT_FS")
+    if not spec:
+        return
+    with _lock:
+        windows = _parse_fs_spec(spec)
+        for w_op, first, last in windows:
+            if w_op != op and w_op != "*":
+                continue
+            key = w_op  # "*" windows share one counter across ops
+            n = _fs_counts.get(key, 0) + 1
+            _fs_counts[key] = n
+            if first <= n <= last:
+                raise InjectedFault(
+                    f"injected fs fault: op={op!r} call #{n} "
+                    f"(PADDLE_FAULT_FS={spec!r})")
+            return  # first matching window owns the counter
+
+
+def nan_poison_step() -> Optional[int]:
+    """Step number (1-indexed) whose gradients SpmdTrainer poisons with
+    NaN, or None. Read at trainer BUILD time — the poison compiles into
+    the step as a jnp.where on the step counter."""
+    v = os.environ.get("PADDLE_FAULT_NAN_STEP")
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def maybe_kill_worker(worker_id: int, batches_done: int):
+    """Fault point inside a dataloader worker process: hard-exit (no
+    cleanup, like an OOM SIGKILL) once the armed worker has produced
+    `after_n` batches."""
+    spec = os.environ.get("PADDLE_FAULT_WORKER_KILL")
+    if not spec:
+        return
+    try:
+        w, after_n = (int(x) for x in spec.split(":"))
+    except ValueError:
+        return
+    if worker_id == w and batches_done >= after_n:
+        os._exit(137)
+
+
+def maybe_sigterm(step: int):
+    """Fault point on the training thread: deliver SIGTERM to this
+    process right after step k (once per process)."""
+    global _sigterm_fired
+    v = os.environ.get("PADDLE_FAULT_SIGTERM_STEP")
+    if not v or _sigterm_fired:
+        return
+    try:
+        k = int(v)
+    except ValueError:
+        return
+    if step >= k:
+        _sigterm_fired = True
+        os.kill(os.getpid(), signal.SIGTERM)
