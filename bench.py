@@ -48,16 +48,23 @@ def _flash_blocks(seq, head_dim, causal=True):
 
 
 def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
-                remat=None):
+                remat=None, smoke=False):
     import numpy as np
     import paddle_tpu as paddle
-    from paddle_tpu.distributed import SpmdTrainer, create_mesh
+    from paddle_tpu.distributed import SpmdTrainer, async_dispatch, \
+        create_mesh
     from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.io.device_prefetch import DevicePrefetcher
     from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion)
     from paddle_tpu.models.gpt import gpt_configs
+    from paddle_tpu.utils.compile_cache import ensure_compile_cache
     from dataclasses import replace
     import jax
+
+    # persistent XLA compile cache: warm bench runs skip the 95s
+    # warmup+compile that BENCH_r05 paid on every invocation
+    cache_dir = ensure_compile_cache()
 
     # blocked cross-entropy (no [B,S,V] logits) and scan-over-layers
     # (O(1) traced transformer bodies) are ON by default; env
@@ -106,51 +113,78 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     for _ in range(warmup):
         loss = trainer.train_step(ids, labels)
     loss.block_until_ready()
-    log(f"  warmup+compile {time.perf_counter() - t0:.1f}s "
-        f"loss={float(loss):.4f}")
+    warmup_s = time.perf_counter() - t0
+    log(f"  warmup+compile {warmup_s:.1f}s loss={float(loss):.4f}")
 
     # evidence the Pallas flash kernel engages in THIS compiled step:
     # pallas kernels lower to tpu custom-calls in the step's HLO
+    # (skipped in smoke mode: re-lowering isn't part of that contract)
     flash_in_step = None
-    try:
-        batch_dev = trainer.shard_batch((ids, labels))
-        import jax.numpy as jnp
-        lowered = trainer.step_executable.lower(
-            trainer.params, trainer.opt_state, trainer.buffers,
-            jnp.asarray(1e-4, jnp.float32), jnp.asarray(1, jnp.int32),
-            *batch_dev)
-        txt = lowered.as_text()
-        # the Pallas kernel lowers to a tpu_custom_call target; the XLA
-        # composite fallback (which also carries 'flash' in op metadata)
-        # and @Sharding custom-calls must NOT satisfy this check
-        flash_in_step = "tpu_custom_call" in txt
-        log(f"  flash kernel in step HLO: {flash_in_step}")
-    except Exception as e:
-        log(f"  flash HLO check skipped: {type(e).__name__}: {e}")
+    if not smoke:
+        try:
+            batch_dev = trainer.shard_batch((ids, labels))
+            import jax.numpy as jnp
+            lowered = trainer.step_executable.lower(
+                trainer.params, trainer.opt_state, trainer.buffers,
+                jnp.asarray(1e-4, jnp.float32), jnp.asarray(1, jnp.int32),
+                *batch_dev)
+            txt = lowered.as_text()
+            # the Pallas kernel lowers to a tpu_custom_call target; the
+            # XLA composite fallback (which also carries 'flash' in op
+            # metadata) and @Sharding custom-calls must NOT satisfy this
+            flash_in_step = "tpu_custom_call" in txt
+            log(f"  flash kernel in step HLO: {flash_in_step}")
+        except Exception as e:
+            log(f"  flash HLO check skipped: {type(e).__name__}: {e}")
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.train_step(ids, labels)
+    # measured loop, PIPELINED: a DevicePrefetcher device_puts the next
+    # batches with the trainer's sharding on a background thread while
+    # the step runs, and nothing reads the loss back until the end —
+    # the host only dispatches (this is the tentpole being measured)
+    prefetch_depth = int(os.environ.get("PADDLE_TPU_PREFETCH_DEPTH", "2"))
+    async_dispatch.reset_host_sync_count()
+    if prefetch_depth > 0:
+        prefetcher = DevicePrefetcher(
+            ((ids, labels) for _ in range(steps)), trainer.shard_batch,
+            depth=prefetch_depth, timings=trainer._timings)
+        t0 = time.perf_counter()
+        for dev_ids, dev_labels in prefetcher:
+            loss = trainer.train_step(dev_ids, dev_labels)
+    else:
+        # PADDLE_TPU_PREFETCH_DEPTH=0: honor the documented kill-switch
+        # (A/B the transfer thread out), same as Model.fit
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.train_step(ids, labels)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
+    # syncs during the measured window: the final barrier only.  A
+    # regression that re-introduces a per-step float(loss)/np.asarray
+    # shows up here (bench --smoke asserts on it)
+    host_syncs_measured = async_dispatch.host_sync_count()
 
     # async checkpoint cost: what the TRAIN THREAD pays for a save (the
     # device->host snapshot; serialization+commit run in the background)
     ckpt_save_ms = ckpt_async = None
-    try:
-        import tempfile
-        from paddle_tpu.distributed.resilience import CheckpointManager
-        with tempfile.TemporaryDirectory() as td:
-            mgr = CheckpointManager(td, keep_last=1, async_save=True)
-            t0 = time.perf_counter()
-            mgr.save(trainer, step=trainer._step_count)
-            ckpt_save_ms = round((time.perf_counter() - t0) * 1e3, 2)
-            mgr.wait()
-            ckpt_async = True
-            log(f"  ckpt: train-thread blocked {ckpt_save_ms}ms, "
-                f"commit {mgr.last_commit_ms:.0f}ms (background)")
-    except Exception as e:
-        log(f"  ckpt bench skipped: {type(e).__name__}: {e}")
+    if not smoke:
+        try:
+            import tempfile
+            from paddle_tpu.distributed.resilience import CheckpointManager
+            with tempfile.TemporaryDirectory() as td:
+                mgr = CheckpointManager(td, keep_last=1, async_save=True)
+                t0 = time.perf_counter()
+                mgr.save(trainer, step=trainer._step_count)
+                ckpt_save_ms = round((time.perf_counter() - t0) * 1e3, 2)
+                mgr.wait()
+                ckpt_async = True
+                log(f"  ckpt: train-thread blocked {ckpt_save_ms}ms, "
+                    f"commit {mgr.last_commit_ms:.0f}ms (background)")
+        except Exception as e:
+            log(f"  ckpt bench skipped: {type(e).__name__}: {e}")
+
+    # ONE stats read: the property itself syncs the on-device anomaly
+    # counters, so re-evaluating it per key would pollute sync_ms
+    trainer_stats = trainer.stats
 
     step_ms = dt / steps * 1e3
     tokens_per_sec = batch * seq * steps / dt
@@ -178,6 +212,14 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
         "ckpt_async": ckpt_async,
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        # step-time breakdown (trainer.stats): where the wall clock went
+        "warmup_s": round(warmup_s, 2),
+        "prefetch_depth": prefetch_depth,
+        "host_syncs_measured": host_syncs_measured,
+        "compile_cache_dir": cache_dir,
+        **{k: trainer_stats[k] for k in
+           ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
+            "compile_ms_cold", "steps_timed")},
     }
 
 
@@ -267,12 +309,54 @@ def bench_flash(seqs=(1024, 2048, 4096), batch=8):
     return rows
 
 
+def bench_smoke():
+    """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
+    `python bench.py --smoke`): asserts the step-time breakdown fields
+    exist and that the measured loop performed NO per-step host sync
+    (the one allowed sync is the final barrier), then re-runs the same
+    tiny config to measure the persistent-cache warm start.  Exits
+    non-zero on any violated invariant, so CI catches dispatch-path
+    regressions before a TPU bench ever runs."""
+    required = ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
+                "compile_ms_cold", "steps_timed", "host_syncs_measured",
+                "prefetch_depth")
+    cold = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
+                       use_flash=False, remat=False, smoke=True)
+    missing = [k for k in required if k not in cold]
+    if missing:
+        raise SystemExit(f"bench --smoke: stats fields missing: {missing}")
+    if cold["host_syncs_measured"] > 1:
+        raise SystemExit(
+            f"bench --smoke: {cold['host_syncs_measured']} host syncs in "
+            f"a {cold['steps']}-step window (max 1: the final barrier) — "
+            f"a per-step sync crept back into the dispatch path")
+    # second identical run in the same process: fresh trainer, fresh jit
+    # objects, so its first-call cost shows the compile-cache warm path
+    warm = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
+                       use_flash=False, remat=False, smoke=True)
+    out = {
+        "metric": "bench_smoke", "ok": True,
+        "compile_ms_cold": cold["compile_ms_cold"],
+        "compile_ms_warm": warm["compile_ms_cold"],
+        "compile_cache_dir": cold["compile_cache_dir"],
+        **{k: cold[k] for k in required},
+    }
+    log(f"  smoke ok: cold compile {cold['compile_ms_cold']:.0f}ms, "
+        f"warm {warm['compile_ms_cold']:.0f}ms, "
+        f"syncs {cold['host_syncs_measured']}")
+    print(json.dumps(out))
+
+
 def main():
     import jax
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
     log(f"bench: platform={dev.platform} "
         f"kind={getattr(dev, 'device_kind', '?')}")
+
+    if "--smoke" in sys.argv:
+        bench_smoke()
+        return
 
     if "--flash" in sys.argv:
         rows = bench_flash()
@@ -329,35 +413,47 @@ def main():
         elif r["mfu"] > result["mfu"] and not r["pathological"]:
             result = r
 
-    def release_device_memory():
+    def release_device_memory(force_clear=False):
         """Failed candidates must not poison later ones: drop compiled
         executables and force-collect so the dead trainer's params/opt
         state leave HBM (keeping the raised exception object alive would
         pin its traceback frames -> the arrays; that leak produced
-        ResourceExhausted on configs that fit fine in a fresh process)."""
+        ResourceExhausted on configs that fit fine in a fresh process).
+
+        With the persistent compile cache ON, the unconditional
+        jax.clear_caches() between candidates is gone: in-memory
+        executables are cheap to keep and expensive to rebuild when the
+        remote-compile service is degraded.  Failure paths still clear
+        (force_clear=True) — a dead trainer's executables are pure HBM
+        ballast."""
         import gc
         import jax as _jax
+        from paddle_tpu.utils.compile_cache import compile_cache_enabled
         gc.collect()
-        try:
-            _jax.clear_caches()
-        except Exception:
-            pass
+        if force_clear or not compile_cache_enabled():
+            try:
+                _jax.clear_caches()
+            except Exception:
+                pass
         gc.collect()
 
     sweep_flash = os.environ.get("BENCH_FLASH", "1") != "0"
     for config_name, batch, seq, steps, warmup, remat in sweep:
+        failed = False
         try:
             consider(bench_train_retry(config_name, batch, seq, steps,
                                        warmup, use_flash=sweep_flash,
                                        remat=remat, tries=2))
         except Exception as e:  # OOM etc: skip this point
+            failed = True
             last_err = f"{type(e).__name__}: {str(e)[:300]}"
             log(f"  {config_name} b{batch} failed: {last_err}")
-        release_device_memory()
+        release_device_memory(force_clear=failed)
     if result is None or result["pathological"]:
         # flash kernel itself may be the pathology: try composite path
         for config_name, batch, seq, steps, warmup, remat in \
                 sweep[:1] + fallbacks:
+            failed = False
             try:
                 consider(bench_train_retry(config_name, batch, seq, steps,
                                            warmup, use_flash=False,
@@ -365,10 +461,11 @@ def main():
                 if result is not None and not result["pathological"]:
                     break
             except Exception as e:
+                failed = True
                 last_err = f"{type(e).__name__}: {str(e)[:300]}"
                 log(f"  {config_name} b{batch} (no-flash) failed: "
                     f"{last_err}")
-            release_device_memory()
+            release_device_memory(force_clear=failed)
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_err}")
 
@@ -406,6 +503,27 @@ def main():
             log(f"  flash microbench fallback failed: "
                 f"{type(e).__name__}: {str(e)[:200]}")
 
+    # warm-start proof on the winning config: a fresh trainer's first
+    # step should deserialize from the persistent cache instead of
+    # recompiling (the 95s-every-run tax BENCH_r05 paid).  2 steps, and
+    # the transient-compile retry covers a flaky cache-miss recompile.
+    compile_ms_warm = None
+    from paddle_tpu.utils.compile_cache import compile_cache_enabled
+    if compile_cache_enabled() and not result["pathological"] and \
+            os.environ.get("BENCH_WARM", "1") != "0":
+        try:
+            warm = bench_train_retry(
+                result["config"], result["batch"], result["seq"], 2, 1,
+                use_flash=result["use_flash"], remat=result["remat"],
+                tries=2)
+            compile_ms_warm = warm["compile_ms_cold"]
+            log(f"  compile: cold {result['compile_ms_cold']:.0f}ms -> "
+                f"warm {compile_ms_warm:.0f}ms (persistent cache)")
+        except Exception as e:
+            log(f"  warm-compile check skipped: "
+                f"{type(e).__name__}: {str(e)[:200]}")
+        release_device_memory()
+
     out = {
         "metric": "gpt_train_mfu",
         "value": round(result["mfu"] * 100, 2),
@@ -415,6 +533,7 @@ def main():
         else 0.0,
     }
     out.update(result)
+    out["compile_ms_warm"] = compile_ms_warm
     out["flash_speedup"] = flash_speedup
     out["candidates"] = candidates
     print(json.dumps(out))

@@ -20,7 +20,10 @@ from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
 from . import fleet  # noqa: F401
 from . import spmd  # noqa: F401
-from .spmd import SpmdTrainer, dp_train_step  # noqa: F401
+from .spmd import SpmdTrainer, dp_train_step, StepResult  # noqa: F401
+from . import async_dispatch  # noqa: F401
+from .async_dispatch import (  # noqa: F401
+    LazyValue, host_sync_count, reset_host_sync_count)
 from .recompute import recompute, RecomputeWrapper  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import (  # noqa: F401

@@ -27,6 +27,7 @@ or fail loudly (round-1 verdict: silent flags are worse than errors).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -37,12 +38,14 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
+from . import async_dispatch
+from .async_dispatch import StepResult
 from .fleet.strategy import DistributedStrategy
 from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
                    compile_mesh_guard)
 
 __all__ = ["SpmdTrainer", "dp_train_step", "zero_sharding_spec",
-           "build_param_specs"]
+           "build_param_specs", "StepResult"]
 
 
 def _is_floating(a) -> bool:
@@ -124,6 +127,27 @@ class SpmdTrainer:
             getattr(model, "config", None), "sp_axis", None) or "sp"
         self._donate = donate
         self._step_count = 0
+
+        # persistent XLA compile cache (PADDLE_TPU_COMPILE_CACHE): warm
+        # restarts skip the multi-minute recompile of identical steps
+        from ..utils.compile_cache import ensure_compile_cache
+        ensure_compile_cache()
+
+        # step-time breakdown (trainer.stats / bench JSON): where did the
+        # wall clock go — waiting for data, placing it, dispatching the
+        # compiled step, or blocked on a host sync.  compile_ms_cold is
+        # the first-call cost per executable in THIS process (trace +
+        # compile or persistent-cache deserialize + first run).
+        self._timings = {
+            "data_wait_ms": 0.0, "h2d_ms": 0.0, "dispatch_ms": 0.0,
+            "sync_ms": 0.0, "compile_ms_cold": 0.0, "steps_timed": 0,
+        }
+        # h2d_ms is written by BOTH the train thread and a
+        # DevicePrefetcher thread (shard_batch runs on each); the
+        # read-modify-write needs a lock or increments get lost
+        import threading
+        self._timings_lock = threading.Lock()
+        self._first_call_keys: set = set()
 
         st = self.strategy
         if st.pipeline:
@@ -402,12 +426,51 @@ class SpmdTrainer:
 
     def shard_batch(self, batch):
         """Host batch -> device arrays sharded over 'dp' on dim 0 (the
-        reference fed per-device scopes; one device_put here)."""
+        reference fed per-device scopes; one device_put here).
+
+        Thread-safe and donation-safe: produces fresh committed arrays
+        that never alias trainer state, so a DevicePrefetcher may call
+        it from a background thread while the step runs.  Leaves that
+        are ALREADY committed with the right sharding (a prefetched
+        batch re-entering train_step) pass through untouched."""
+        t0 = time.perf_counter()
+
         def put(x):
-            arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+            arr = x.data if isinstance(x, Tensor) else x
+            if isinstance(arr, jax.Array):
+                sh = self._batch_sharding(arr)
+                if getattr(arr, "sharding", None) == sh and \
+                        getattr(arr, "committed", False):
+                    return arr  # already placed (device prefetch path)
+                return jax.device_put(arr, sh)
+            arr = jnp.asarray(arr)
             return jax.device_put(arr, self._batch_sharding(arr))
-        return jax.tree_util.tree_map(
+
+        out = jax.tree_util.tree_map(
             put, batch, is_leaf=lambda x: isinstance(x, Tensor))
+        dt = (time.perf_counter() - t0) * 1e3
+        with self._timings_lock:
+            self._timings["h2d_ms"] += dt
+        return out
+
+    def _timed_call(self, key, *args, count_step=True):
+        """Invoke a compiled executable, splitting wall time into the
+        first call (compile/deserialize) vs steady-state dispatch.
+        count_step=False folds the call into dispatch_ms without
+        advancing steps_timed (the gradient-merge 'update' executable:
+        its cost amortizes over the window, so dispatch_ms/steps_timed
+        stays a truthful per-train_step figure)."""
+        t0 = time.perf_counter()
+        res = self._compiled[key](*args)
+        dt = (time.perf_counter() - t0) * 1e3
+        if key in self._first_call_keys:
+            self._timings["dispatch_ms"] += dt
+            if count_step:
+                self._timings["steps_timed"] += 1
+        else:
+            self._first_call_keys.add(key)
+            self._timings["compile_ms_cold"] += dt
+        return res
 
     # ------------------------------------------------------------------
     def _loss_and_buffers(self, params, buffers, inputs, labels,
@@ -811,10 +874,11 @@ class SpmdTrainer:
     # ------------------------------------------------------------------
     def train_step(self, inputs, labels, return_outputs=False):
         """Run one compiled training step. inputs/labels: array, Tensor,
-        or tuple thereof. Returns the loss as a device array (no host
-        sync — call float() when you actually need the number); with
-        return_outputs=True returns (loss, outputs) — the forward outputs
-        ride along for metric computation (hapi)."""
+        or tuple thereof. Returns a lazy StepResult (no host sync — the
+        device scalar is fetched, once, when you float()/read it; until
+        then the host keeps dispatching ahead of the device); with
+        return_outputs=True returns (StepResult, outputs) — the forward
+        outputs ride along for metric computation (hapi)."""
         from . import env as _env
         _env.heartbeat()  # launcher watchdog liveness (no-op if unset)
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
@@ -835,17 +899,17 @@ class SpmdTrainer:
             # intermediates (MoE dispatch buffers) while jit traces
             with compile_mesh_guard(self.mesh):
                 if self.fp16_scaling:
-                    res = self._compiled[key](
-                        self.params, self.opt_state, self.buffers,
+                    res = self._timed_call(
+                        key, self.params, self.opt_state, self.buffers,
                         self._scaler_state, lr, step_no, *batch)
                 elif self._anom_skip:
-                    res = self._compiled[key](
-                        self.params, self.opt_state, self.buffers,
+                    res = self._timed_call(
+                        key, self.params, self.opt_state, self.buffers,
                         self._anomaly_state, lr, step_no, *batch)
                 else:
-                    res = self._compiled[key](
-                        self.params, self.opt_state, self.buffers, lr,
-                        step_no, *batch)
+                    res = self._timed_call(
+                        key, self.params, self.opt_state, self.buffers,
+                        lr, step_no, *batch)
             res = list(res)
             guard = res.pop() \
                 if (self._check_nan_inf or self._anom_rollback) else None
@@ -862,13 +926,22 @@ class SpmdTrainer:
             self.optimizer._step_count = self._step_count
             if self._anom_rollback:
                 # one host sync per step — the policy's documented price
+                t_sync = time.perf_counter()
                 self._handle_rollback(guard)
+                async_dispatch.record_host_sync()
+                self._timings["sync_ms"] += \
+                    (time.perf_counter() - t_sync) * 1e3
             elif guard is not None:
+                t_sync = time.perf_counter()
                 self._raise_nonfinite(
                     guard, names=["loss"] if self.fp16_scaling else None)
+                async_dispatch.record_host_sync()
+                self._timings["sync_ms"] += \
+                    (time.perf_counter() - t_sync) * 1e3
             from ..testing import faults as _faults
             _faults.maybe_sigterm(self._step_count)
-            return (loss, outs) if return_outputs else loss
+            result = StepResult(loss, timings=self._timings, outputs=outs)
+            return (result, outs) if return_outputs else result
         if return_outputs:
             raise NotImplementedError(
                 "return_outputs with gradient merge (k_steps > 1) is not "
@@ -882,12 +955,13 @@ class SpmdTrainer:
             self._compiled["update"] = self._build_update()
         with compile_mesh_guard(self.mesh):
             if self._anom_skip:
-                res = self._compiled[akey](
-                    self.params, self._grad_buf, self.buffers,
+                res = self._timed_call(
+                    akey, self.params, self._grad_buf, self.buffers,
                     self._anomaly_state, *batch)
             else:
-                res = self._compiled[akey](
-                    self.params, self._grad_buf, self.buffers, *batch)
+                res = self._timed_call(
+                    akey, self.params, self._grad_buf, self.buffers,
+                    *batch)
         res = list(res)
         guard = res.pop() if self._check_nan_inf else None
         if self._anom_skip:
@@ -896,18 +970,21 @@ class SpmdTrainer:
             self._grad_buf, self.buffers, loss = res
         self._step_count += 1
         if guard is not None:
+            t_sync = time.perf_counter()
             self._raise_nonfinite(guard)
+            async_dispatch.record_host_sync()
+            self._timings["sync_ms"] += (time.perf_counter() - t_sync) * 1e3
         if self._step_count % self.k_steps == 0:
             step_no = jnp.asarray(
                 self._step_count // self.k_steps, jnp.int32)
             self.params, self.opt_state, self._grad_buf = \
-                self._compiled["update"](
-                    self.params, self.opt_state, self._grad_buf, lr,
-                    step_no)
+                self._timed_call(
+                    "update", self.params, self.opt_state, self._grad_buf,
+                    lr, step_no, count_step=False)
             self.optimizer._step_count = self._step_count // self.k_steps
         from ..testing import faults as _faults
         _faults.maybe_sigterm(self._step_count)
-        return loss
+        return StepResult(loss, timings=self._timings)
 
     def eval_step(self, inputs):
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
@@ -1031,19 +1108,35 @@ class SpmdTrainer:
 
     @property
     def stats(self) -> dict:
-        """Resilience counters for logging/bench: the active anomaly
-        policy plus how many updates it discarded (skip: on-device
-        counter; fp16: steps whose optimizer-visible count did not
-        advance; rollback: host rewinds)."""
+        """Resilience counters + step-time breakdown for logging/bench.
+
+        Anomaly half: the active policy plus how many updates it
+        discarded (skip: on-device counter; fp16: steps whose
+        optimizer-visible count did not advance; rollback: host rewinds).
+        Reading the on-device counters is itself a host sync — call this
+        at log boundaries, not per step.
+
+        Timing half (milliseconds, cumulative since construction):
+        ``data_wait_ms`` (consumer blocked on the prefetch queue),
+        ``h2d_ms`` (host spent placing batches), ``dispatch_ms``
+        (steady-state compiled-step calls), ``sync_ms`` (blocked host
+        read-backs), ``compile_ms_cold`` (first-call compile/deserialize
+        cost per executable), ``steps_timed``."""
         s = {"anomaly_policy": self.anomaly_policy,
              "rollback_steps": self._rollback_count}
+        t_sync = time.perf_counter()
         if self._anomaly_state is not None:
             s["skipped_steps"] = int(self._anomaly_state["skipped"])
+            async_dispatch.record_host_sync()
         elif self.fp16_scaling and self._scaler_state is not None:
             s["skipped_steps"] = int(
                 self._step_count - int(self._scaler_state["t"]))
+            async_dispatch.record_host_sync()
         else:
             s["skipped_steps"] = 0
+        self._timings["sync_ms"] += (time.perf_counter() - t_sync) * 1e3
+        for k, v in self._timings.items():
+            s[k] = round(v, 3) if isinstance(v, float) else v
         return s
 
     @property

@@ -108,20 +108,30 @@ class ProgBarLogger(Callback):
         self.epoch = epoch
         self.steps = 0
 
+    @staticmethod
+    def _format_logs(logs):
+        """Format scalar-ish log values; lazy device scalars
+        (StepResult/LazyValue) are forced here — printing IS the sync
+        point, and it only happens at log_freq boundaries."""
+        items = []
+        for k, v in (logs or {}).items():
+            if k == "batch_size" or isinstance(v, bool):
+                continue
+            try:
+                items.append(f"{k}: {float(v):.4f}")
+            except (TypeError, ValueError):
+                continue
+        return " - ".join(items)
+
     def on_train_batch_end(self, step, logs=None):
         self.steps += 1
         if self.verbose and step % self.log_freq == 0:
-            items = " - ".join(
-                f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                if isinstance(v, (int, float)) and k != "batch_size")
-            print(f"Epoch {self.epoch} step {step}: {items}")
+            print(f"Epoch {self.epoch} step {step}: "
+                  f"{self._format_logs(logs)}")
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            items = " - ".join(
-                f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                if isinstance(v, (int, float)) and k != "batch_size")
-            print(f"Epoch {epoch} done: {items}")
+            print(f"Epoch {epoch} done: {self._format_logs(logs)}")
 
 
 class History(Callback):

@@ -119,17 +119,22 @@ class Model:
                 "strategy.gradient_merge (the accumulation then happens "
                 "inside the compiled step with a dp-sharded buffer)")
         if self.compiled and update:
+            # non-blocking dispatch: the StepResult (and lazy metric
+            # accumulators) hold device values; nothing reads them back
+            # here, so the host keeps queueing steps ahead of the device.
+            # fit() forces them once per log_freq window; a direct caller
+            # pays the sync at float(loss).
             tr = self._ensure_trainer()
             want_out = bool(self._metrics)
             if want_out:
                 loss, outputs = tr.train_step(tuple(inputs), tuple(labels),
                                               return_outputs=True)
                 out_t = [Tensor(o) for o in _to_list(outputs)]
-                metrics = self._update_metrics(out_t, labels)
+                metrics = self._update_metrics(out_t, labels, lazy=True)
             else:
                 loss = tr.train_step(tuple(inputs), tuple(labels))
                 metrics = {}
-            return ([float(loss)], metrics) if metrics else [float(loss)]
+            return ([loss], metrics) if metrics else [loss]
         self.network.train()
         outputs = self.network(*[self._t(i) for i in inputs])
         losses = self._compute_loss(outputs, labels)
@@ -145,13 +150,15 @@ class Model:
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         if self.compiled:
+            from ..distributed.async_dispatch import StepResult
             tr = self._ensure_trainer()
             outputs = [Tensor(o) for o in
                        _to_list(tr.eval_step(tuple(inputs)))]
             losses = self._compute_loss(outputs, labels) \
                 if self._loss is not None else None
-            metrics = self._update_metrics(outputs, labels)
-            loss_list = [float(losses)] if losses is not None else []
+            metrics = self._update_metrics(outputs, labels, lazy=True)
+            loss_list = [StepResult(losses, timings=tr._timings)] \
+                if losses is not None else []
             return (loss_list, metrics) if metrics else loss_list
         self.network.eval()
         with no_grad():
@@ -175,7 +182,14 @@ class Model:
         return _to_list(outputs)
 
     def _t(self, x):
-        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+        if isinstance(x, Tensor):
+            return x
+        import jax
+        if isinstance(x, jax.Array):
+            # prefetched device array: wrap in place — np.asarray here
+            # would be a per-batch host sync
+            return Tensor(x)
+        return Tensor(np.asarray(x))
 
     def _compute_loss(self, outputs, labels):
         outs = _to_list(outputs)
@@ -184,15 +198,24 @@ class Model:
             raise RuntimeError("call prepare(loss=...) before training")
         return self._loss(*(outs + labs))
 
-    def _update_metrics(self, outputs, labels):
+    def _update_metrics(self, outputs, labels, lazy=False):
+        """Run metric compute/update per batch. lazy=True (compiled
+        mode) defers the accumulate() read-back behind a LazyValue so
+        the step loop stays sync-free; readers (ProgBarLogger at
+        log_freq, evaluate() at epoch end) force the CURRENT running
+        value when they format it."""
         res = {}
         outs = _to_list(outputs)
         labs = [self._t(l) for l in labels]
         for m in self._metrics:
             pre = m.compute(*(outs + labs))
             m.update(*_to_list(pre))
-            res[m.name()[0] if isinstance(m.name(), list) else m.name()] = \
-                m.accumulate()
+            key = m.name()[0] if isinstance(m.name(), list) else m.name()
+            if lazy:
+                from ..distributed.async_dispatch import LazyValue
+                res[key] = LazyValue(m.accumulate)
+            else:
+                res[key] = m.accumulate()
         return res
 
     # ---- loops ------------------------------------------------------------
@@ -200,8 +223,17 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            auto_resume=False):
-        """reference hapi/model.py:1244. auto_resume=True (with
+            auto_resume=False, prefetch_depth=None):
+        """reference hapi/model.py:1244.
+
+        Compiled mode runs a PIPELINED step loop: batches are
+        device_put with the trainer's sharding by a background
+        DevicePrefetcher (``prefetch_depth`` in flight, default 2 /
+        ``PADDLE_TPU_PREFETCH_DEPTH``; 0 disables), and losses/metrics
+        stay on device as lazy values that are read back at most once
+        per ``log_freq`` steps — in between, the host only dispatches.
+
+        auto_resume=True (with
         save_dir) checkpoints the FULL training state under
         save_dir/auto each save_freq epochs (asynchronously in compiled
         mode, with per-entry checksums) and, on restart, restores the
@@ -230,6 +262,9 @@ class Model:
             start_epoch, skip_steps = self._auto_restore(auto_dir)
             from ..distributed.resilience import PreemptionGuard
             guard = PreemptionGuard().install()
+        if prefetch_depth is None:
+            prefetch_depth = int(os.environ.get(
+                "PADDLE_TPU_PREFETCH_DEPTH", "2"))
         self.stop_training = False
         self.preempted = False
         try:
@@ -242,7 +277,8 @@ class Model:
                         accumulate_grad_batches, num_iters,
                         skip_steps=(skip_steps if epoch == start_epoch
                                     else 0),
-                        guard=guard, epoch=epoch, auto_dir=auto_dir)
+                        guard=guard, epoch=epoch, auto_dir=auto_dir,
+                        log_freq=log_freq, prefetch_depth=prefetch_depth)
                 except _Preempted:
                     self.preempted = True
                     self.stop_training = True
@@ -404,50 +440,95 @@ class Model:
             return epoch + 1, 0
         return 0, 0
 
+    @staticmethod
+    def _resolve_logs(logs):
+        """Force any lazy (device-resident) log values to concrete
+        numbers — THE host sync point of the fit loop."""
+        from ..distributed.async_dispatch import resolve
+        for k, v in list(logs.items()):
+            logs[k] = resolve(v)
+        return logs
+
     def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None,
-                       skip_steps=0, guard=None, epoch=0, auto_dir=None):
+                       skip_steps=0, guard=None, epoch=0, auto_dir=None,
+                       log_freq=10, prefetch_depth=0):
         from ..profiler import StepTimer
         logs = {}
         timer = StepTimer(warmup=1)
         timer.start()
         for m in self._metrics:
             m.reset()
-        for step, batch in enumerate(loader):
-            if num_iters is not None and step >= num_iters:
-                break
-            if mode == "train" and step < skip_steps:
-                # mid-epoch resume: these batches were consumed before
-                # the preemption checkpoint — fast-forward past them so
-                # the data order matches the uninterrupted run
-                continue
-            cbks.on_batch_begin(mode, step, logs)
-            ins, labs = self._split_batch(batch)
-            update = (step + 1) % accum == 0
-            if mode == "train":
-                out = self.train_batch(ins, labs, update=update)
-                self._global_batch_count += 1
-            else:
-                out = self.eval_batch(ins, labs)
-            if isinstance(out, tuple):
-                loss_list, metrics = out
-            else:
-                loss_list, metrics = out, {}
-            if loss_list:
-                logs["loss"] = loss_list[0]
-            logs.update(metrics)
-            logs["batch_size"] = (labs[0].shape[0] if labs else
-                                  ins[0].shape[0])
-            timer.tick()
-            if timer.last_ms is not None:
-                # per-step wall time (reference profiler summary table)
-                logs["step_time_ms"] = round(timer.last_ms, 3)
-            cbks.on_batch_end(mode, step, logs)
-            if mode == "train" and guard is not None and guard.preempted:
-                # the in-flight step has drained (train_batch returned):
-                # commit a final synchronous checkpoint and unwind
-                self._preempt_save(auto_dir, epoch, step)
-                raise _Preempted()
-        return logs
+        it = iter(loader)
+        first_step = 0
+        if mode == "train" and skip_steps:
+            # mid-epoch resume: these batches were consumed before the
+            # preemption checkpoint — fast-forward past them ON THE HOST
+            # (no device transfer) so the data order matches the
+            # uninterrupted run
+            for _ in range(skip_steps):
+                try:
+                    next(it)
+                except StopIteration:
+                    break
+                first_step += 1
+        prefetcher = None
+        if mode == "train" and self.compiled and prefetch_depth > 0:
+            # overlap host->device placement with compute: batches enter
+            # train_batch already committed with the trainer's sharding.
+            # Cap the source at num_iters FIRST so the prefetcher never
+            # pulls (and discards) batches past the iteration budget —
+            # a single-pass stream would lose them for the next epoch
+            if num_iters is not None:
+                import itertools
+                it = itertools.islice(it, max(0, num_iters - first_step))
+            from ..io.device_prefetch import DevicePrefetcher
+            tr = self._ensure_trainer()
+            prefetcher = DevicePrefetcher(it, tr.shard_batch,
+                                          depth=prefetch_depth,
+                                          timings=tr._timings)
+            it = iter(prefetcher)
+        try:
+            for step, batch in enumerate(it, start=first_step):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin(mode, step, logs)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accum == 0
+                if mode == "train":
+                    out = self.train_batch(ins, labs, update=update)
+                    self._global_batch_count += 1
+                else:
+                    out = self.eval_batch(ins, labs)
+                if isinstance(out, tuple):
+                    loss_list, metrics = out
+                else:
+                    loss_list, metrics = out, {}
+                if loss_list:
+                    logs["loss"] = loss_list[0]
+                logs.update(metrics)
+                logs["batch_size"] = (labs[0].shape[0] if labs else
+                                      ins[0].shape[0])
+                timer.tick()
+                if timer.last_ms is not None:
+                    # per-step wall time (reference profiler summary
+                    # table); under async dispatch this is host-side
+                    # time — the device view is stats["dispatch_ms"]
+                    logs["step_time_ms"] = round(timer.last_ms, 3)
+                if step % log_freq == 0:
+                    # the ONLY scheduled read-back: once per log window
+                    self._resolve_logs(logs)
+                cbks.on_batch_end(mode, step, logs)
+                if mode == "train" and guard is not None and \
+                        guard.preempted:
+                    # the in-flight step has drained (train_batch
+                    # returned): commit a final synchronous checkpoint
+                    # and unwind
+                    self._preempt_save(auto_dir, epoch, step)
+                    raise _Preempted()
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+        return self._resolve_logs(logs)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None,
@@ -461,7 +542,7 @@ class Model:
         if _inner_cbks is None:
             cbks.on_begin("eval")
         logs = self._run_one_epoch(loader, cbks, "eval",
-                                   num_iters=num_iters)
+                                   num_iters=num_iters, log_freq=log_freq)
         if _inner_cbks is None:
             cbks.on_end("eval", logs)
         out = {}

@@ -20,4 +20,5 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler)
 from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
                          WorkerInfo, get_worker_info)
+from .device_prefetch import DevicePrefetcher  # noqa: F401
 from .in_memory import InMemoryDataset  # noqa: F401

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import itertools
 import os
-import queue
 import threading
 from typing import Callable, Optional
 
@@ -48,73 +47,110 @@ def default_collate_fn(batch):
 
 
 class _Prefetcher:
-    """Thread-pool prefetch of collated batches into a bounded queue."""
+    """Thread-pool prefetch of collated batches into a bounded reorder
+    buffer.
+
+    Ordered hand-off: each worker pulls (seq, thunk) under the condition
+    lock and posts (seq, result); the consumer emits strictly in seq
+    order.  Hygiene guarantees:
+
+    - REAL backpressure: workers stall when results + in-flight tasks
+      reach capacity (the old version only throttled the consumer, so
+      workers could collate the whole dataset into RAM);
+    - exceptions from the batch ITERATOR itself (not just from thunks)
+      surface on the consumer instead of silently killing a worker and
+      deadlocking the emit loop;
+    - leaving the loop early (break / GeneratorExit) wakes every worker
+      via the stop flag and joins them — no leaked daemon threads
+      spinning on a dead iterator.
+    """
 
     def __init__(self, make_batch_iter, num_workers, capacity):
         self._make_iter = make_batch_iter
         self._num_workers = max(1, num_workers)
-        self._capacity = capacity
+        self._capacity = max(1, capacity)
 
     def __iter__(self):
-        q: queue.Queue = queue.Queue(maxsize=self._capacity)
-        sentinel = object()
-        it = self._make_iter()
-        lock = threading.Lock()
-        # ordered hand-off: each worker takes (seq, thunk) and posts
-        # (seq, result); a reorder buffer preserves batch order.
-        task_iter = enumerate(it)
-        results = {}
+        task_iter = enumerate(self._make_iter())
         cond = threading.Condition()
-        done_flag = [False]
-        stop_flag = [False]
-        next_emit = [0]
-        inflight = [0]
+        iter_lock = threading.Lock()  # serializes next(task_iter) ONLY
+        results = {}  # seq -> collated batch | raised exception
+        state = {"done": False, "stop": False, "inflight": 0,
+                 "next_emit": 0, "iter_error": None}
 
         def worker():
             while True:
-                if stop_flag[0]:
-                    return
-                with lock:
+                with cond:
+                    # reserve an in-flight slot BEFORE pulling a task so
+                    # the consumer's done-and-drained exit check stays
+                    # sound while we hold only the iterator lock
+                    while (not state["stop"] and not state["done"] and
+                           len(results) + state["inflight"] >=
+                           self._capacity):
+                        cond.wait(timeout=0.1)
+                    if state["stop"] or state["done"]:
+                        return
+                    state["inflight"] += 1
+                # pull OUTSIDE the condition lock: a slow batch iterator
+                # (streaming dataset) must not block the consumer from
+                # emitting batches that are already collated
+                got, err = False, None
+                with iter_lock:
                     try:
                         seq, thunk = next(task_iter)
-                        inflight[0] += 1
+                        got = True
                     except StopIteration:
-                        with cond:
-                            done_flag[0] = True
-                            cond.notify_all()
-                        return
+                        pass
+                    except BaseException as e:
+                        # the iterator itself failed: deliver it instead
+                        # of leaving the consumer waiting forever
+                        err = e
+                if not got:
+                    with cond:
+                        if err is not None:
+                            state["iter_error"] = err
+                        state["done"] = True
+                        state["inflight"] -= 1
+                        cond.notify_all()
+                    return
                 try:
                     res = thunk()
                 except BaseException as e:  # propagate to consumer
                     res = e
                 with cond:
                     results[seq] = res
-                    inflight[0] -= 1
+                    state["inflight"] -= 1
                     cond.notify_all()
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self._num_workers)]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"pd-prefetch-{i}")
+                   for i in range(self._num_workers)]
         for t in threads:
             t.start()
 
         try:
             while True:
                 with cond:
-                    while next_emit[0] not in results:
-                        if done_flag[0] and inflight[0] == 0 and \
-                                next_emit[0] not in results:
+                    while True:
+                        if state["next_emit"] in results:
+                            res = results.pop(state["next_emit"])
+                            state["next_emit"] += 1
+                            cond.notify_all()  # frees worker capacity
+                            break
+                        if state["done"] and state["inflight"] == 0:
+                            if state["iter_error"] is not None:
+                                raise state["iter_error"]
                             return
-                        cond.wait(timeout=0.1)
-                    res = results.pop(next_emit[0])
-                    next_emit[0] += 1
-                    # backpressure: cap the reorder buffer
-                    while len(results) > self._capacity:
                         cond.wait(timeout=0.1)
                 if isinstance(res, BaseException):
                     raise res
                 yield res
         finally:
-            stop_flag[0] = True
+            with cond:
+                state["stop"] = True
+                cond.notify_all()
+            for t in threads:
+                t.join(timeout=5)
 
 
 class _MultiprocessIter:

@@ -13,6 +13,20 @@ def _np(x):
     return np.asarray(x.data) if isinstance(x, Tensor) else np.asarray(x)
 
 
+def _raw(x):
+    """Underlying array WITHOUT forcing a host copy (device arrays stay
+    on device; see Accuracy's async path)."""
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_device_array(a) -> bool:
+    try:
+        import jax
+        return isinstance(a, jax.Array)
+    except Exception:  # pragma: no cover - jax always present here
+        return False
+
+
 class Metric:
     def __init__(self):
         pass
@@ -47,25 +61,48 @@ class Accuracy(Metric):
         self.count = [0] * len(self.topk)
 
     def compute(self, pred, label, *args):
-        pred_np = _np(pred)
-        label_np = _np(label)
+        pred_raw, label_raw = _raw(pred), _raw(label)
+        maxk = max(self.topk)
+        if _is_device_array(pred_raw):
+            # device path (compiled trainers): the whole top-k check is
+            # queued as async device work — no host transfer per step
+            import jax.numpy as jnp
+            label_j = label_raw if _is_device_array(label_raw) \
+                else jnp.asarray(np.asarray(label_raw))
+            if label_j.ndim == pred_raw.ndim and label_j.shape[-1] == 1:
+                label_j = label_j.squeeze(-1)
+            order = jnp.argsort(-pred_raw, axis=-1)[..., :maxk]
+            correct = (order == label_j[..., None]).astype(jnp.float32)
+            return Tensor(correct)
+        pred_np = np.asarray(pred_raw)
+        label_np = np.asarray(label_raw)
         if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
             label_np = label_np.squeeze(-1)
-        maxk = max(self.topk)
         order = np.argsort(-pred_np, axis=-1)[..., :maxk]
         correct = order == label_np[..., None]
         return Tensor(correct.astype(np.float32))
 
     def update(self, correct, *args):
-        c = _np(correct)
-        num = c.shape[0] if c.ndim > 0 else 1
+        c = _raw(correct)
+        if _is_device_array(c):
+            # accumulate on device: total becomes a device scalar chain;
+            # the blocking read-back happens once, when a logger /
+            # evaluate actually wants the number.  The return value
+            # keeps the Metric.update contract (the running accuracy)
+            # as a lazy float-alike instead of syncing here
+            for i, k in enumerate(self.topk):
+                self.total[i] = self.total[i] + c[..., :k].sum()
+                self.count[i] += int(np.prod(c.shape[:-1]))
+            from ..distributed.async_dispatch import LazyValue
+            return LazyValue(self.accumulate)
+        c = np.asarray(c)
         for i, k in enumerate(self.topk):
             self.total[i] += float(c[..., :k].sum())
             self.count[i] += int(np.prod(c.shape[:-1]))
         return self.accumulate()
 
     def accumulate(self):
-        res = [t / c if c > 0 else 0.0
+        res = [float(t) / c if c > 0 else 0.0
                for t, c in zip(self.total, self.count)]
         return res[0] if len(res) == 1 else res
 

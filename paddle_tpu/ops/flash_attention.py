@@ -30,6 +30,7 @@ Layout contract: q [B, S, H, D], k/v [B, S, Hkv, D] with H % Hkv == 0.
 from __future__ import annotations
 
 import functools
+import json
 import math
 import os
 import time
@@ -300,6 +301,80 @@ _AUTOTUNE_TABLE = {
 _SWEEP_CACHE: dict = {}
 _SWEEP_CANDIDATES = (128, 256, 512, 1024)
 
+# On-disk persistence of the sweep table: an on-device sweep costs tens
+# of seconds of compile+measure per shape, so PADDLE_TPU_FLASH_AUTOTUNE=
+# sweep pays once per (device_kind, seq, head_dim, causal) ACROSS
+# processes, not once per run.  PADDLE_TPU_FLASH_AUTOTUNE_CACHE names the
+# JSON file ("0"/"off" disables persistence; default
+# ~/.cache/paddle_tpu/flash_autotune.json).
+_SWEEP_STORE_STATE = {"loaded": False}
+
+
+def _sweep_store_path():
+    p = os.environ.get("PADDLE_TPU_FLASH_AUTOTUNE_CACHE", "").strip()
+    if p.lower() in ("0", "off", "false", "none"):
+        return None
+    if p:
+        return os.path.expanduser(p)
+    base = os.environ.get("XDG_CACHE_HOME") or \
+        os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "paddle_tpu", "flash_autotune.json")
+
+
+def _sweep_key_str(key) -> str:
+    kind, seq, d, causal = key
+    return f"{kind}|{seq}|{d}|{int(causal)}"
+
+
+def _load_sweep_store():
+    """Merge the on-disk sweep table into the process cache (once);
+    entries this process already swept win over stale disk entries."""
+    if _SWEEP_STORE_STATE["loaded"]:
+        return
+    _SWEEP_STORE_STATE["loaded"] = True
+    path = _sweep_store_path()
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            return
+        for k, v in data.items():
+            parts = str(k).split("|")
+            if len(parts) != 4:
+                continue
+            key = (parts[0], int(parts[1]), int(parts[2]),
+                   bool(int(parts[3])))
+            _SWEEP_CACHE.setdefault(key, (int(v[0]), int(v[1])))
+    except (OSError, ValueError, TypeError, IndexError, KeyError):
+        pass  # corrupt/unreadable table: sweep again, then rewrite it
+
+
+def _persist_sweep_entry(key, val):
+    """Atomic read-modify-write of the sweep table via
+    framework.fs.open_for_write (fsync before rename: a crash can never
+    commit a truncated table that silently re-costs the sweep);
+    best-effort."""
+    path = _sweep_store_path()
+    if not path:
+        return
+    try:
+        data = {}
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                data = loaded
+        except (OSError, ValueError):
+            pass
+        data[_sweep_key_str(key)] = list(val)
+        from ..framework.fs import open_for_write
+        with open_for_write(path, "w") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+    except OSError:
+        pass
+
 
 def _normalize_kind(kind: str) -> str:
     k = (kind or "").lower()
@@ -338,6 +413,10 @@ def get_block_sizes(seq: int, head_dim: int, causal: bool,
     # the local kind) and return tiles tuned for the wrong chip
     if (mode == "sweep" and kind == _device_kind()
             and kind.startswith(("v2", "v3", "v4", "v5", "v6"))):
+        # a previous process may have paid for this sweep already
+        _load_sweep_store()
+        if key in _SWEEP_CACHE:
+            return _SWEEP_CACHE[key]
         try:
             return autotune_sweep(seq, head_dim, causal)
         except Exception:  # sweep is best-effort; fall through to table
@@ -404,6 +483,7 @@ def autotune_sweep(seq: int, head_dim: int, causal: bool, batch: int = 1,
                 best, best_t = (bq, bk), t
     best = (_pick_block(seq, best[0]), _pick_block(seq, best[1]))
     _SWEEP_CACHE[key] = best
+    _persist_sweep_entry(key, best)
     return best
 
 

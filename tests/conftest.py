@@ -44,23 +44,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 # serve cache hits only for 1-partition/1-replica programs; SPMD
 # programs always recompile (their entries are still written, so
 # nothing else regresses if a future jaxlib fixes deserialization).
-from jax._src import compilation_cache as _cc  # noqa: E402
+from paddle_tpu.utils.compile_cache import \
+    _install_cpu_spmd_guard  # noqa: E402
 
-_orig_get = _cc.get_executable_and_time
-
-
-def _guarded_get(cache_key, compile_options, backend):
-    try:
-        ebo = compile_options.executable_build_options
-        multi = ebo.num_partitions > 1 or ebo.num_replicas > 1
-    except Exception:
-        multi = True
-    if multi:
-        return None, None
-    return _orig_get(cache_key, compile_options, backend)
-
-
-_cc.get_executable_and_time = _guarded_get
+_install_cpu_spmd_guard()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
