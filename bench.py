@@ -309,6 +309,151 @@ def bench_flash(seqs=(1024, 2048, 4096), batch=8):
     return rows
 
 
+def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
+                gen_tokens=None, num_requests=None, smoke=False):
+    """Serving-path bench (`--serve`): continuous-batching engine
+    throughput on the winning train config's model — prefill+decode
+    tokens/sec, p50/p95 per-decode-step latency, slot occupancy, and
+    the recompile-free-decode proof (compile counter).  `--serve
+    --smoke` is the CPU dry run: asserts the decode executable compiles
+    ONCE across 8 generated tokens and that host syncs stay at one per
+    decode step + one per admission."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from dataclasses import replace
+    from paddle_tpu.distributed import async_dispatch
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_configs
+    from paddle_tpu.utils import compile_counter
+    from paddle_tpu.utils.compile_cache import ensure_compile_cache
+
+    cache_dir = ensure_compile_cache()
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if smoke or not on_tpu:
+        config_name = config_name or "gpt3-tiny"
+        batch_slots = batch_slots or 2
+        prompt_len = prompt_len or 6
+        gen_tokens = gen_tokens or 8
+        num_requests = num_requests or 3
+        seq = 64
+    else:
+        # the winning train config (BENCH_r05 trajectory: gpt3-125m)
+        config_name = config_name or os.environ.get("BENCH_CONFIG",
+                                                    "gpt3-125m")
+        batch_slots = batch_slots or int(
+            os.environ.get("PADDLE_TPU_DECODE_SLOTS", 8))
+        prompt_len = prompt_len or 128
+        gen_tokens = gen_tokens or 64
+        num_requests = num_requests or 2 * batch_slots
+        seq = int(os.environ.get("BENCH_SEQ", 2048))
+    cfg = replace(gpt_configs()[config_name], max_seq_len=seq,
+                  fused_ce=False)
+    log(f"serve bench: {config_name} slots={batch_slots} "
+        f"prompt={prompt_len} gen={gen_tokens} requests={num_requests} "
+        f"({cfg.num_params() / 1e6:.0f}M params)")
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    eng = InferenceEngine(model, batch_slots=batch_slots)
+    rng = np.random.RandomState(0)
+
+    bucket = eng._bucket_for(prompt_len)
+    t0 = time.perf_counter()
+    eng.warmup(buckets=[bucket])
+    warmup_s = time.perf_counter() - t0
+    log(f"  warmup+compile {warmup_s:.1f}s "
+        f"(cold {eng.stats['compile_ms_cold']:.0f}ms)")
+
+    prompts = [rng.randint(1, cfg.vocab_size, (prompt_len,))
+               .astype(np.int32) for _ in range(num_requests)]
+    snap = compile_counter.snapshot()
+    async_dispatch.reset_host_sync_count()
+    step_ms, admit_ms = [], []
+    t0 = time.perf_counter()
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=gen_tokens)
+    while eng._queue or eng.num_active:
+        p0 = eng._timings["prefills"]
+        ts = time.perf_counter()
+        eng.step()
+        dt_ms = (time.perf_counter() - ts) * 1e3
+        # p50/p95 must mean DECODE latency: steps that ran a prefill
+        # admission are tracked separately (a prefill is orders of
+        # magnitude slower and would drown the decode trend line)
+        if eng._timings["prefills"] == p0:
+            step_ms.append(dt_ms)
+        else:
+            admit_ms.append(dt_ms)
+    dt = time.perf_counter() - t0
+    syncs = async_dispatch.host_sync_count()
+    stats = eng.stats
+
+    total_tokens = stats["tokens_generated"] + stats["prefills"]
+    decode_lat = np.percentile(step_ms, [50, 95]) if step_ms else [0, 0]
+    out = {
+        "metric": "gpt_serve_tokens_per_sec",
+        "value": round(total_tokens / dt, 2),
+        "unit": "tok/s",
+        "config": config_name,
+        "batch_slots": batch_slots,
+        "prompt_len": prompt_len,
+        "prefill_bucket": bucket,
+        "gen_tokens": gen_tokens,
+        "num_requests": num_requests,
+        "wall_s": round(dt, 3),
+        "tokens_generated": total_tokens,
+        "step_ms_p50": round(float(decode_lat[0]), 3),
+        "step_ms_p95": round(float(decode_lat[1]), 3),
+        "admit_step_ms_p50": round(float(np.percentile(admit_ms, 50)), 3)
+        if admit_ms else None,
+        "admit_steps": len(admit_ms),
+        "slot_occupancy": stats["slot_occupancy"],
+        "prefill_ms_total": stats["prefill_ms"],
+        "decode_ms_total": stats["decode_ms"],
+        "decode_tokens_per_sec": stats["decode_tokens_per_sec"],
+        "compile_ms_cold": stats["compile_ms_cold"],
+        "xla_compiles_measured": snap.new_compiles,
+        "host_syncs_measured": syncs,
+        "warmup_s": round(warmup_s, 2),
+        "compile_cache_dir": cache_dir,
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    log(f"  serve: {out['value']} tok/s, decode p50 "
+        f"{out['step_ms_p50']}ms p95 {out['step_ms_p95']}ms, "
+        f"occupancy {out['slot_occupancy']}, "
+        f"compiles in measured window: {snap.new_compiles}")
+
+    if smoke:
+        # the acceptance contract: after warmup, the decode loop (8+
+        # generated tokens across several requests) triggers ZERO new
+        # XLA compiles — a shape wobble (the old concat cache) would
+        # recompile per token and show up here
+        if snap.new_compiles != 0:
+            raise SystemExit(
+                f"serve --smoke: {snap.new_compiles} XLA compiles during "
+                f"the measured window (expected 0 after warmup — the "
+                f"decode path is not shape-stable)")
+        # one sync per decode step (sampled-token readback) + one per
+        # admission (first-token sample): anything more means a hidden
+        # per-step read-back crept into the scheduler
+        budget = stats["decode_steps"] + stats["prefills"]
+        if syncs > budget:
+            raise SystemExit(
+                f"serve --smoke: {syncs} host syncs for "
+                f"{stats['decode_steps']} decode steps + "
+                f"{stats['prefills']} admissions (budget {budget})")
+        if stats["tokens_generated"] < 8:
+            raise SystemExit("serve --smoke: fewer than 8 tokens decoded")
+        out["metric"] = "serve_smoke"
+        out["ok"] = True
+        log(f"  serve smoke ok: {total_tokens} tokens, 0 compiles, "
+            f"{syncs} syncs/{budget} budget")
+    print(json.dumps(out))
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
@@ -353,6 +498,10 @@ def main():
     on_tpu = dev.platform not in ("cpu",)
     log(f"bench: platform={dev.platform} "
         f"kind={getattr(dev, 'device_kind', '?')}")
+
+    if "--serve" in sys.argv:
+        bench_serve(smoke="--smoke" in sys.argv)
+        return
 
     if "--smoke" in sys.argv:
         bench_smoke()

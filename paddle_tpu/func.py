@@ -17,7 +17,8 @@ import jax
 from .core.tensor import Tensor
 from .nn.layer_base import Layer
 
-__all__ = ["functional_state", "functional_call", "functional_forward"]
+__all__ = ["functional_state", "functional_call", "functional_forward",
+           "functional_apply"]
 
 
 def functional_state(layer: Layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
@@ -88,6 +89,23 @@ def functional_call(layer: Layer, params: Dict[str, Any],
 def functional_forward(layer: Layer, params, *args, **kwargs):
     """Convenience: functional_call without buffer plumbing."""
     out, _ = functional_call(layer, params, {}, *args, **kwargs)
+    return out
+
+
+def functional_apply(layer: Layer, method: str, params: Dict[str, Any],
+                     *args, **kwargs):
+    """Run a named METHOD of `layer` with `params` bound, returning the
+    method's outputs with Tensors unwrapped to raw arrays.
+
+    Unlike :func:`functional_call` this does not Tensor-wrap positional
+    args — non-array pytrees (a serving engine's StaticKVCache, scalar
+    ints) pass through untouched — and it targets methods beyond
+    ``forward`` (``prefill`` / ``decode_step`` on GPTForCausalLM), which
+    is what the inference engine jits.
+    """
+    with _swapped(layer, params, {}):
+        out = getattr(layer, method)(*args, **kwargs)
+        out = _unwrap(out)
     return out
 
 

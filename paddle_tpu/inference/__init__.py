@@ -6,5 +6,8 @@ StableHLO export; this package adds the C ABI around it (capi/) so
 non-Python serving stacks can load the same artifact.
 """
 from ..jit.api import load as load_predictor  # noqa: F401
+from .engine import (  # noqa: F401
+    InferenceEngine, Request, default_prefill_buckets)
 
-__all__ = ["load_predictor"]
+__all__ = ["load_predictor", "InferenceEngine", "Request",
+           "default_prefill_buckets"]

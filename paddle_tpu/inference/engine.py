@@ -1,0 +1,426 @@
+"""High-throughput serving engine: two executables + continuous batching.
+
+The training side of this repo got its fast path in PRs 1-3 (fused
+kernels, async dispatch, persistent compile cache); this module is the
+same discipline for inference, built from two papers:
+
+- Pope et al., *Efficiently Scaling Transformer Inference*: ONE compiled
+  **prefill** executable per prompt-length bucket writing into a
+  statically-shaped, preallocated KV cache
+  (``models.gpt.StaticKVCache``, layout
+  ``[layers, batch_slots, max_seq, kv_heads, head_dim]``), and ONE
+  compiled **decode** executable appending a single token per slot and
+  running the fused single-token attention kernel
+  (``ops.decode_attention``) over the cache.  Nothing in the decode loop
+  ever changes shape, so generating N tokens costs ZERO new XLA
+  compiles (the contract ``bench.py --serve --smoke`` and
+  tests/test_inference_engine.py assert via utils.compile_counter).
+- Yu et al., *Orca*: **continuous batching** — the decode batch is a set
+  of fixed ``batch_slots``; new requests are admitted into free slots
+  BETWEEN decode steps (a prefill touches only its slot's cache rows),
+  and finished requests retire their slot immediately instead of making
+  short requests wait for the longest one in a static batch.
+
+Sampling (greedy / temperature / top-k / top-p) runs inside the decode
+executable, so each step costs exactly one host read-back — the sampled
+token ids the scheduler needs for EOS retirement and admission (counted
+by distributed.async_dispatch's host-sync counter, same as training).
+
+Both executables go through the persistent XLA compile cache
+(utils.compile_cache), so a server restart deserializes instead of
+recompiling.  On the CPU backend the engine does NOT donate its cache
+operands: jaxlib 0.4.x mis-aliases donated buffers in executables
+deserialized from the persistent cache (the same hazard PR 2 hit with
+rollback) — the compile-cache guard plus no-donation keeps the test
+suite's warm cache safe.  On TPU, donation is on and the cache updates
+are true in-place writes.
+
+Knobs: ``PADDLE_TPU_DECODE_SLOTS`` (default 8) and
+``PADDLE_TPU_PREFILL_BUCKETS`` (comma-separated lengths; default powers
+of two up to max_seq_len).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed import async_dispatch
+from ..func import functional_apply, functional_state
+from ..utils import compile_cache, compile_counter
+
+__all__ = ["InferenceEngine", "Request", "default_prefill_buckets"]
+
+
+def default_prefill_buckets(max_seq_len: int, lo: int = 16) -> List[int]:
+    """Powers of two in [lo, max_seq_len], always including max_seq_len.
+    ``PADDLE_TPU_PREFILL_BUCKETS="64,256,1024"`` overrides."""
+    env = os.environ.get("PADDLE_TPU_PREFILL_BUCKETS", "").strip()
+    if env:
+        bks = sorted({int(x) for x in env.split(",") if x.strip()})
+    else:
+        bks = []
+        b = lo
+        while b < max_seq_len:
+            bks.append(b)
+            b *= 2
+        bks.append(max_seq_len)
+    return [b for b in bks if b <= max_seq_len] or [max_seq_len]
+
+
+class Request:
+    """One in-flight generation request (host-side bookkeeping)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, eos_id, temperature, top_p):
+        self.rid = next(Request._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = -1 if eos_id is None else int(eos_id)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.generated: List[int] = []
+        self.slot: Optional[int] = None
+        self.done = False
+
+
+class InferenceEngine:
+    """Continuous-batching serving engine for GPTForCausalLM.
+
+    Usage::
+
+        eng = InferenceEngine(model, batch_slots=8)
+        rid = eng.add_request(prompt_ids, max_new_tokens=64, eos_id=eos)
+        outputs = eng.run()          # {rid: np.int32 generated tokens}
+
+    or incrementally: ``eng.step()`` admits queued requests into free
+    slots and decodes one token for every active slot; finished
+    requests appear in ``eng.results``.
+    """
+
+    def __init__(self, model, batch_slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_buckets: Optional[List[int]] = None,
+                 cache_dtype=None, top_k: int = 0, seed: int = 0,
+                 mesh=None, donate: Optional[bool] = None):
+        model.eval()
+        self.model = model
+        cfg = model.cfg
+        self.batch_slots = int(batch_slots or
+                               os.environ.get("PADDLE_TPU_DECODE_SLOTS", 8))
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        if self.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"position table ({cfg.max_seq_len})")
+        self.buckets = sorted(prefill_buckets or
+                              default_prefill_buckets(self.max_seq_len))
+        self.top_k = int(top_k)
+
+        # persistent compile cache: a restarted server deserializes its
+        # prefill/decode executables instead of recompiling them
+        compile_cache.ensure_compile_cache()
+        compile_counter.install()
+
+        self.params, _ = functional_state(model)
+        self.cache = model.init_kv_cache(self.batch_slots,
+                                         self.max_seq_len, cache_dtype)
+        self.mesh = mesh
+        if mesh is not None:
+            self._shard_over_mesh(mesh)
+
+        # CPU + persistent cache + donation = the PR 2 mis-alias hazard
+        # (deserialized executables alias donated buffers wrongly on
+        # jaxlib 0.4.x CPU); see module docstring
+        if donate is None:
+            env = os.environ.get("PADDLE_TPU_INFER_DONATE")
+            if env is not None:
+                donate = env != "0"
+            else:
+                donate = jax.default_backend() not in ("cpu",)
+        self._donate = bool(donate)
+        # donation + CPU + persistent cache: never DESERIALIZE these
+        # executables (compile fresh; entries still written) — see
+        # compile_cache.suspend_cpu_cache_hits
+        self._suspend_cache_hits = (self._donate and
+                                    jax.default_backend() == "cpu")
+        dargs = (1,) if self._donate else ()
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dargs)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dargs)
+        self._sample_jit = jax.jit(self._sample_from_logits)
+
+        self._key = jax.random.PRNGKey(int(seed))
+
+        # scheduler state
+        self._queue: deque = deque()
+        self._slots: List[Optional[Request]] = [None] * self.batch_slots
+        self._next_token = np.zeros(self.batch_slots, np.int32)
+        self._slot_len = np.zeros(self.batch_slots, np.int64)
+        self._temps = np.zeros(self.batch_slots, np.float32)
+        self._top_ps = np.ones(self.batch_slots, np.float32)
+        self.results: Dict[int, np.ndarray] = {}
+
+        # stats machinery (same shape as SpmdTrainer._timings/stats)
+        self._timings = {
+            "prefill_ms": 0.0, "decode_ms": 0.0, "sync_ms": 0.0,
+            "compile_ms_cold": 0.0, "prefills": 0, "decode_steps": 0,
+            "tokens_generated": 0, "occupancy_sum": 0.0,
+        }
+        self._first_call_keys: set = set()
+        self._counters0 = compile_counter.snapshot()
+
+    # ---- sharding -----------------------------------------------------
+    def _shard_over_mesh(self, mesh):
+        """Place the cache like a training activation: batch_slots over
+        'dp', kv heads over 'tp' when those axes exist (best-effort —
+        a 1-device mesh or missing axes degrade to replicated)."""
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            names = mesh.axis_names
+            dp = "dp" if "dp" in names and mesh.shape["dp"] > 1 else None
+            tp = "tp" if "tp" in names and mesh.shape["tp"] > 1 else None
+            kv_spec = NamedSharding(mesh, P(None, dp, None, tp, None))
+            len_spec = NamedSharding(mesh, P(dp))
+            self.cache = type(self.cache)(
+                jax.device_put(self.cache.k, kv_spec),
+                jax.device_put(self.cache.v, kv_spec),
+                jax.device_put(self.cache.lengths, len_spec))
+        except Exception:  # sharding is an optimization, never fatal
+            pass
+
+    # ---- compiled functions -------------------------------------------
+    def _prefill_fn(self, params, cache, ids, slot, prompt_len):
+        return functional_apply(self.model, "prefill", params,
+                                ids, cache, slot, prompt_len)
+
+    def _sample_from_logits(self, logits, key, temps, top_ps):
+        """Greedy when temps<=0, else temperature + (static) top-k +
+        (per-slot) top-p sampling. logits [N, V] f32."""
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        v = logits.shape[-1]
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if self.top_k and self.top_k < v:
+            kth = jax.lax.top_k(scaled, self.top_k)[0][:, -1:]
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        # top-p in sorted space: keep tokens whose PRECEDING cumulative
+        # mass is < p (the first token always survives)
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        s_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        probs = jax.nn.softmax(s_logits, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        s_logits = jnp.where(csum - probs < top_ps[:, None],
+                             s_logits, -1e30)
+        choice = jax.random.categorical(key, s_logits, axis=-1)
+        sampled = jnp.take_along_axis(
+            sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _decode_fn(self, params, cache, tokens, active, key, temps,
+                   top_ps):
+        logits, cache = functional_apply(self.model, "decode_step",
+                                         params, tokens, cache, active)
+        key, sub = jax.random.split(key)
+        nxt = self._sample_from_logits(logits, sub, temps, top_ps)
+        return nxt, key, cache
+
+    # ---- timing helpers -----------------------------------------------
+    def _timed(self, kind, key, fn):
+        t0 = time.perf_counter()
+        if key not in self._first_call_keys:
+            # first call per executable = trace + compile/deserialize
+            self._first_call_keys.add(key)
+            if self._suspend_cache_hits:
+                with compile_cache.suspend_cpu_cache_hits():
+                    out = fn()
+            else:
+                out = fn()
+            self._timings["compile_ms_cold"] += \
+                (time.perf_counter() - t0) * 1e3
+        else:
+            out = fn()
+            self._timings[kind] += (time.perf_counter() - t0) * 1e3
+        return out
+
+    # ---- public API ---------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    eos_id: Optional[int] = None,
+                    temperature: float = 0.0, top_p: float = 1.0) -> int:
+        """Queue a generation request; returns its id. Admitted into a
+        free slot at the next step()."""
+        req = Request(prompt, max_new_tokens, eos_id, temperature, top_p)
+        if req.prompt.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens exceeds the largest "
+                f"prefill bucket ({self.buckets[-1]})")
+        if req.prompt.size >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens leaves no room to "
+                f"generate within max_seq_len={self.max_seq_len}")
+        self._queue.append(req)
+        return req.rid
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _admit(self, req: Request, slot: int):
+        bucket = self._bucket_for(req.prompt.size)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :req.prompt.size] = req.prompt
+        plen = req.prompt.size
+        logits, cache = self._timed(
+            "prefill_ms", ("prefill", bucket), lambda: self._prefill_jit(
+                self.params, self.cache, jnp.asarray(ids),
+                np.int32(slot), np.int32(plen)))
+        self.cache = cache
+        # first generated token comes from the prefill logits
+        self._key, sub = jax.random.split(self._key)
+        # np (not list) literals: a python-float list would lower an
+        # extra convert_element_type executable on the admission path
+        tok = self._timed(
+            "prefill_ms", ("sample", 1), lambda: self._sample_jit(
+                logits, sub,
+                np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_p], np.float32)))
+        tok = int(np.asarray(tok)[0])
+        async_dispatch.record_host_sync()
+        self._timings["prefills"] += 1
+        req.slot = slot
+        self._slots[slot] = req
+        self._slot_len[slot] = plen
+        self._temps[slot] = req.temperature
+        self._top_ps[slot] = req.top_p
+        req.generated.append(tok)
+        self._next_token[slot] = tok
+        self._retire_if_done(req, tok)
+
+    def _retire_if_done(self, req: Request, last_tok: int):
+        """EOS / max-new-tokens / capacity retirement; frees the slot."""
+        slot = req.slot
+        full = self._slot_len[slot] + 1 >= self.max_seq_len
+        if (last_tok == req.eos_id
+                or len(req.generated) >= req.max_new_tokens or full):
+            req.done = True
+            self.results[req.rid] = np.asarray(req.generated, np.int32)
+            self._slots[slot] = None
+            self._temps[slot] = 0.0
+            self._top_ps[slot] = 1.0
+            req.slot = None
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    def step(self) -> int:
+        """Admit queued requests into free slots, then decode one token
+        for every active slot. Returns the number of tokens produced
+        this step (admission prefills included)."""
+        produced = 0
+        for slot, occ in enumerate(self._slots):
+            if occ is None and self._queue:
+                # each admission produces its first token from the
+                # prefill logits
+                self._admit(self._queue.popleft(), slot)
+                produced += 1
+        active_np = np.asarray(
+            [1 if r is not None else 0 for r in self._slots], np.int32)
+        if not active_np.any():
+            return produced
+        self._timings["occupancy_sum"] += float(active_np.mean())
+        nxt, self._key, cache = self._timed(
+            "decode_ms", ("decode", 0), lambda: self._decode_jit(
+                self.params, self.cache, jnp.asarray(self._next_token),
+                jnp.asarray(active_np), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        self.cache = cache
+        # the ONE host sync of the decode step: the scheduler needs the
+        # sampled ids for EOS retirement and admission
+        t0 = time.perf_counter()
+        nxt_np = np.asarray(nxt)
+        async_dispatch.record_host_sync()
+        self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
+        self._timings["decode_steps"] += 1
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = int(nxt_np[slot])
+            self._slot_len[slot] += 1        # the token we just appended
+            req.generated.append(tok)
+            self._next_token[slot] = tok
+            produced += 1
+            self._timings["tokens_generated"] += 1
+            self._retire_if_done(req, tok)
+        return produced
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive step() until every queued request finished; returns
+        {request_id: generated token ids}."""
+        while self._queue or self.num_active:
+            self.step()
+        return self.results
+
+    def warmup(self, buckets: Optional[List[int]] = None):
+        """Compile (or deserialize from the persistent cache) the decode
+        + sampling executables and the given prefill buckets before
+        traffic arrives.  Uses slot 0 with throwaway tokens; the cache
+        lengths are reset afterwards so the garbage stays masked."""
+        assert self.num_active == 0 and not self._queue, \
+            "warmup() must run before traffic"
+        for b in (buckets or [self.buckets[0]]):
+            ids = jnp.zeros((1, b), jnp.int32)
+            logits, cache = self._timed(
+                "prefill_ms", ("prefill", b), lambda: self._prefill_jit(
+                    self.params, self.cache, ids, np.int32(0),
+                    np.int32(1)))
+            self.cache = cache
+        self._key, sub = jax.random.split(self._key)
+        self._timed("prefill_ms", ("sample", 1), lambda: self._sample_jit(
+            logits, sub, jnp.zeros((1,), jnp.float32),
+            jnp.ones((1,), jnp.float32)))
+        nxt, self._key, cache = self._timed(
+            "decode_ms", ("decode", 0), lambda: self._decode_jit(
+                self.params, self.cache,
+                jnp.zeros(self.batch_slots, jnp.int32),
+                jnp.zeros(self.batch_slots, jnp.int32), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        # drop the warmup garbage: zero every slot's length (host-side
+        # constant, so no extra executable rides the hot path)
+        self.cache = type(cache)(cache.k, cache.v,
+                                 jnp.zeros((self.batch_slots,), jnp.int32))
+        return self
+
+    @property
+    def stats(self) -> dict:
+        """Cumulative serving stats (SpmdTrainer.stats convention):
+        prefill/decode wall-clock, compile_ms_cold (first call per
+        executable), host sync time, tokens/sec over decode wall-clock,
+        mean slot occupancy, and the process-wide XLA compile/trace
+        deltas since engine construction."""
+        t = self._timings
+        s = {k: (round(v, 3) if isinstance(v, float) else v)
+             for k, v in t.items()}
+        steps = max(t["decode_steps"], 1)
+        s["slot_occupancy"] = round(t["occupancy_sum"] / steps, 4)
+        decode_s = t["decode_ms"] / 1e3
+        s["decode_tokens_per_sec"] = round(
+            t["tokens_generated"] / decode_s, 2) if decode_s > 0 else None
+        s["xla_compiles"] = self._counters0.new_compiles
+        s["jaxpr_traces"] = self._counters0.new_traces
+        s["compile_cache_dir"] = compile_cache.compile_cache_dir()
+        s["batch_slots"] = self.batch_slots
+        s["buckets"] = list(self.buckets)
+        s["donate"] = self._donate
+        return s

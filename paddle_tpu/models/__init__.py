@@ -2,4 +2,4 @@
 benchmarks (BASELINE.json configs #3-#5)."""
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
-    gpt_configs)
+    StaticKVCache, gpt_configs)

@@ -37,7 +37,7 @@ from ..nn.layer_base import Layer, ParamAttr
 from ..nn.layer.common import Dropout, Embedding
 from ..nn.layer.norm import LayerNorm
 from ..nn.layer.container import LayerList
-from ..tensor.manipulation import concat, repeat_interleave
+from ..tensor.manipulation import repeat_interleave
 from ..tensor.math import matmul
 from ..distributed.parallel_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -46,7 +46,50 @@ from ..distributed.mesh import PartitionSpec
 from ..distributed.recompute import RecomputeWrapper
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
-           "GPTPretrainingCriterion", "gpt_configs"]
+           "GPTPretrainingCriterion", "gpt_configs", "StaticKVCache"]
+
+
+class StaticKVCache:
+    """Preallocated serving KV cache: ``k``/``v`` are
+    ``[layers, batch_slots, max_seq, kv_heads, head_dim]`` and
+    ``lengths`` is ``[batch_slots]`` int32 — valid tokens per slot.
+
+    Statically shaped on purpose (Pope et al., *Efficiently Scaling
+    Transformer Inference*): every prefill/decode executable sees the
+    same cache shape, so generating N tokens never changes a shape and
+    never recompiles.  All updates are functional (`lax.dynamic_update_
+    slice` / scatter); under jit with donated cache operands XLA turns
+    them into true in-place writes.  Registered as a pytree so it rides
+    through jit/scan/while_loop carries.
+    """
+
+    __slots__ = ("k", "v", "lengths")
+
+    def __init__(self, k, v, lengths):
+        self.k, self.v, self.lengths = k, v, lengths
+
+    @property
+    def num_layers(self):
+        return self.k.shape[0]
+
+    @property
+    def batch_slots(self):
+        return self.k.shape[1]
+
+    @property
+    def capacity(self):
+        return self.k.shape[2]
+
+    def __repr__(self):
+        return (f"StaticKVCache(layers={self.k.shape[0]}, "
+                f"slots={self.k.shape[1]}, capacity={self.k.shape[2]}, "
+                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype})")
+
+
+jax.tree_util.register_pytree_node(
+    StaticKVCache,
+    lambda c: ((c.k, c.v, c.lengths), None),
+    lambda aux, ch: StaticKVCache(*ch))
 
 
 @dataclass
@@ -166,10 +209,191 @@ class GPTAttention(Layer):
         dp = m.shape.get("dp", 1) if "dp" in m.axis_names else 1
         return b % dp == 0
 
+    def _qkv_arrays(self, x):
+        """qkv projection split into raw arrays q [B,S,H,D],
+        k/v [B,S,Hkv,D].  Inference-path helper: reading ``.data``
+        detaches from the eager autograd tape, which is why
+        ``forward`` keeps its own Tensor-level split (training grads
+        flow through the tape there)."""
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x if isinstance(x, Tensor) else Tensor(x))
+        arr = qkv.data
+        h_dim = cfg.hidden_size
+        kv_dim = cfg.num_kv_heads * cfg.head_dim
+        q = arr[:, :, :h_dim].reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = arr[:, :, h_dim:h_dim + kv_dim].reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim)
+        v = arr[:, :, h_dim + kv_dim:].reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim)
+        return q, k, v
+
+    def _proj_out(self, out_arr, b, s):
+        out = Tensor(out_arr.reshape(b, s, -1))
+        return self.dropout(self.out_proj(out))
+
+    @staticmethod
+    def _upgrade_cache(cache, b, hkv, d, cap, dtype):
+        """Adopt any accepted cache form into the fixed-capacity triple
+        ``(k_buf [B, cap, Hkv, D], v_buf, length)``.
+
+        Accepted: the triple itself; the legacy 2-tuple ``(pk, pv)`` of
+        dense past keys/values (padded into a fresh buffer — its static
+        `past` length stays static, so adopting is compile-stable); and
+        ``(None, None)`` / empty to start a fresh buffer.  The fixed
+        capacity is what kills the per-token recompile: the old concat
+        path changed the cache shape every generated token, forcing XLA
+        to recompile each step and copy O(n²) bytes.
+        """
+        if len(cache) == 3:
+            k_buf, v_buf, length = cache
+            k_buf = k_buf.data if isinstance(k_buf, Tensor) else k_buf
+            v_buf = v_buf.data if isinstance(v_buf, Tensor) else v_buf
+            return k_buf, v_buf, length
+        pk, pv = cache
+        k_buf = jnp.zeros((b, cap, hkv, d), dtype)
+        v_buf = jnp.zeros((b, cap, hkv, d), dtype)
+        if pk is None:
+            return k_buf, v_buf, 0
+        pk = pk.data if isinstance(pk, Tensor) else jnp.asarray(pk)
+        pv = pv.data if isinstance(pv, Tensor) else jnp.asarray(pv)
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, pk.astype(dtype), (0, 0, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, pv.astype(dtype), (0, 0, 0, 0))
+        return k_buf, v_buf, int(pk.shape[1])
+
+    def _attend_fresh(self, q, k, v, b, s):
+        """No-past causal attention on raw arrays — the same
+        ring/flash/composite routing as the no-cache forward, shared by
+        forward_prefill and the fresh-cache legacy path.  Returns raw
+        [b, s, H, D]."""
+        cfg = self.cfg
+        causal = s > 1
+        if cfg.sequence_parallel and self._sp_active(b, s):
+            from ..distributed.ring_attention import \
+                sequence_parallel_attention
+            out = sequence_parallel_attention(
+                Tensor(q), Tensor(k), Tensor(v), sp_axis=cfg.sp_axis,
+                causal=causal)
+            return out.data if isinstance(out, Tensor) else out
+        if cfg.use_flash_attention:
+            return F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                                     causal=causal, training=False).data
+        kf, vf = k, v
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            kf = jnp.repeat(kf, rep, axis=2)
+            vf = jnp.repeat(vf, rep, axis=2)
+        return F.scaled_dot_product_attention(
+            Tensor(q), Tensor(kf), Tensor(vf), is_causal=causal,
+            training=False).data
+
+    def _forward_with_cache(self, x, cache):
+        """Fixed-capacity cached attention (the legacy ``cache=`` path,
+        now recompile-free): write the s new tokens at ``length``, attend
+        query i (absolute position length+i) against buffer keys
+        ``j <= length + i``.  Single-token calls run the fused decode
+        kernel (ops.decode_attention); a fresh cache's multi-token
+        prefill keeps the ring/flash fast path.  Returns
+        ``(out, (k_buf, v_buf, new_length))``.
+
+        The buffer capacity is ``cfg.max_seq_len``; exceeding it raises
+        in eager use (concrete length).  Under jit the length is traced
+        and cannot be checked — writes past capacity clamp to the last
+        position (callers must bound generation, as the engine does)."""
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        cap = cfg.max_seq_len
+        q, k, v = self._qkv_arrays(x)
+        k_buf, v_buf, length = self._upgrade_cache(
+            cache, b, cfg.num_kv_heads, cfg.head_dim, cap, q.dtype)
+        try:
+            concrete_len = int(length)
+        except Exception:  # traced inside jit/scan: unverifiable
+            concrete_len = None
+        if concrete_len is not None and concrete_len + s > cap:
+            raise ValueError(
+                f"kv cache overflow: {concrete_len} cached + {s} new "
+                f"tokens > capacity {cap} (cfg.max_seq_len) — the old "
+                f"concat cache grew past this silently; the static "
+                f"cache cannot")
+        # same offset for every row (the legacy API is uniform-length;
+        # per-slot offsets live in StaticKVCache/forward_decode)
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, k.astype(k_buf.dtype), (0, length, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, v.astype(v_buf.dtype), (0, length, 0, 0))
+        new_len = length + s
+        if s == 1:
+            from .. import ops as _ops
+            lens = jnp.broadcast_to(
+                jnp.asarray(new_len, jnp.int32), (b,))
+            out = _ops.decode_attention(
+                q[:, 0].astype(k_buf.dtype), k_buf, v_buf, lens)
+            out = out[:, None].astype(q.dtype)          # [b, 1, H, D]
+        elif concrete_len == 0:
+            # fresh-cache prefill: nothing valid in the buffer yet, so
+            # this IS plain causal attention — keep the ring/flash path
+            # instead of a [s, cap] masked composite
+            out = self._attend_fresh(q, k, v, b, s)
+        else:
+            kf, vf = k_buf, v_buf
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                kf = jnp.repeat(kf, rep, axis=2)
+                vf = jnp.repeat(vf, rep, axis=2)
+            # bool mask [1, 1, s, cap]: query i sees keys j <= length+i
+            mask = (jnp.arange(cap)[None, :] <=
+                    (jnp.asarray(length) + jnp.arange(s))[:, None])
+            out = F.scaled_dot_product_attention(
+                Tensor(q), Tensor(kf.astype(q.dtype)),
+                Tensor(vf.astype(q.dtype)),
+                attn_mask=mask[None, None], training=False).data
+        out_t = self._proj_out(out, b, s)
+        return out_t, (k_buf, v_buf, new_len)
+
+    def forward_prefill(self, x):
+        """Causal attention over a fresh prompt, also returning the
+        per-token k/v arrays so the caller can write them into a
+        StaticKVCache slot.  Returns ``(out, k [B,S,Hkv,D], v)``."""
+        b, s = x.shape[0], x.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        out = self._attend_fresh(q, k, v, b, s)
+        return self._proj_out(out, b, s), k, v
+
+    def forward_decode(self, x, k_layer, v_layer, lengths):
+        """One decode step over a StaticKVCache layer: write each slot's
+        new k/v at its own ``lengths[b]`` (scatter), then run the fused
+        single-token attention masked to ``j <= lengths[b]``.  x is
+        [B, 1, hidden]; k_layer/v_layer [B, cap, Hkv, D]; lengths [B]
+        int32 (tokens already in the cache, EXCLUDING this one).
+        Returns ``(out, k_layer, v_layer)``."""
+        b = x.shape[0]
+        cap = k_layer.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        idx = jnp.minimum(lengths.astype(jnp.int32), cap - 1)
+        rows = jnp.arange(b)
+        k_layer = k_layer.at[rows, idx].set(k[:, 0].astype(k_layer.dtype))
+        v_layer = v_layer.at[rows, idx].set(v[:, 0].astype(v_layer.dtype))
+        from .. import ops as _ops
+        out = _ops.decode_attention(
+            q[:, 0].astype(k_layer.dtype), k_layer, v_layer, idx + 1)
+        out = out[:, None].astype(q.dtype)               # [b, 1, H, D]
+        return self._proj_out(out, b, 1), k_layer, v_layer
+
     def forward(self, x, attn_mask=None, cache=None):
         cfg = self.cfg
         b = x.shape[0]
         s = x.shape[1]
+        if cache is not None:
+            # generation path: fixed-capacity cache, static shapes (the
+            # old concat-grown cache recompiled every generated token)
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask with a kv cache is not supported; pad "
+                    "tokens are masked by the cache length instead")
+            return self._forward_with_cache(x, cache)
         qkv = self.qkv_proj(x)
         h_dim = cfg.hidden_size
         kv_dim = cfg.num_kv_heads * cfg.head_dim
@@ -180,20 +404,8 @@ class GPTAttention(Layer):
         v = qkv[:, :, h_dim + kv_dim:].reshape(
             [b, s, cfg.num_kv_heads, cfg.head_dim])
 
-        new_cache = None
-        if cache is not None:
-            # decode: append to the kv cache (generation path)
-            pk, pv = cache
-            k = concat([pk, k], axis=1) if pk is not None else k
-            v = concat([pv, v], axis=1) if pv is not None else v
-            new_cache = (k, v)
-
-        # Any multi-token call is causal — including prefill with a cache
-        # (the composite's bottom-right-aligned mask lets query i see keys
-        # <= past + i). Only single-token decode attends unmasked.
         causal = s > 1
-        empty_cache = cache is None or cache[0] is None
-        if (cfg.sequence_parallel and attn_mask is None and empty_cache
+        if (cfg.sequence_parallel and attn_mask is None
                 and self._sp_active(b, s)):
             # ring attention: seq dim sharded over 'sp', KV blocks rotate
             # around the ICI ring (distributed/ring_attention.py). K/V go
@@ -207,10 +419,9 @@ class GPTAttention(Layer):
                 q, k, v, sp_axis=cfg.sp_axis, causal=causal)
             out = out.reshape([b, s, -1])
             out = self.out_proj(out)
-            out = self.dropout(out)
-            return (out, new_cache) if cache is not None else out
+            return self.dropout(out)
 
-        if cfg.use_flash_attention and attn_mask is None and empty_cache:
+        if cfg.use_flash_attention and attn_mask is None:
             # GQA goes in un-expanded: the Pallas kernel walks kv-head
             # groups on its grid, never materializing repeated K/V
             out = F.flash_attention(q, k, v, dropout=cfg.attn_dropout,
@@ -227,8 +438,7 @@ class GPTAttention(Layer):
                 training=self.training)
         out = out.reshape([b, s, -1])
         out = self.out_proj(out)
-        out = self.dropout(out)
-        return (out, new_cache) if cache is not None else out
+        return self.dropout(out)
 
 
 class GPTMLP(Layer):
@@ -287,6 +497,22 @@ class GPTBlock(Layer):
         x = x + self.attn(self.ln_1(x), attn_mask=attn_mask)
         x = x + self.mlp(self.ln_2(x))
         return x
+
+    def forward_prefill(self, x):
+        """Block forward that also surfaces this layer's k/v for the
+        StaticKVCache write. Returns (x, k [B,S,Hkv,D], v)."""
+        a, k, v = self.attn.forward_prefill(self.ln_1(x))
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k, v
+
+    def forward_decode(self, x, k_layer, v_layer, lengths):
+        """Single-token block step over one StaticKVCache layer."""
+        a, k_layer, v_layer = self.attn.forward_decode(
+            self.ln_1(x), k_layer, v_layer, lengths)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_layer, v_layer
 
 
 class GPTModel(Layer):
@@ -384,6 +610,81 @@ class GPTModel(Layer):
         from ..core.autograd import apply
         return apply(scan_fn, x, *flat, name="gpt_scan_layers")
 
+    # ---- serving path: static KV cache --------------------------------
+    def init_kv_cache(self, batch_slots: int, capacity: Optional[int] = None,
+                      dtype=None) -> StaticKVCache:
+        """Allocate the fixed-shape serving cache
+        ``[layers, batch_slots, capacity, kv_heads, head_dim]`` (zeros;
+        per-slot lengths 0). ``capacity`` defaults to max_seq_len;
+        ``dtype`` defaults to the embedding dtype."""
+        cfg = self.cfg
+        cap = int(capacity or cfg.max_seq_len)
+        dt = dtype or self.wte.weight.dtype
+        shape = (cfg.num_layers, int(batch_slots), cap,
+                 cfg.num_kv_heads, cfg.head_dim)
+        return StaticKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                             jnp.zeros((int(batch_slots),), jnp.int32))
+
+    def forward_prefill(self, input_ids, cache: StaticKVCache, slot,
+                        prompt_len):
+        """Prefill ONE slot: run the causal forward over a (possibly
+        padded) prompt ``input_ids [1, s_bucket]``, write every layer's
+        k/v into ``cache`` at ``(layer, slot, 0)``, and set
+        ``lengths[slot] = prompt_len``.  Tokens past ``prompt_len`` are
+        bucket padding: their k/v land beyond the recorded length and
+        are masked out of every later decode step.  Returns
+        ``(hidden [1, s, H], cache)``."""
+        ids = input_ids.data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        s = ids.shape[1]
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        x = self.wte(Tensor(ids)) + self.wpe(pos)
+        x = self.drop(x)
+        ks, vs = [], []
+        for blk in self.blocks:
+            x, k, v = blk.forward_prefill(x)
+            ks.append(k[0])
+            vs.append(v[0])
+        k_new = jnp.stack(ks)[:, None]        # [L, 1, s, Hkv, D]
+        v_new = jnp.stack(vs)[:, None]
+        slot = jnp.asarray(slot, jnp.int32)
+        zero = jnp.asarray(0, jnp.int32)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache.k, k_new.astype(cache.k.dtype),
+            (zero, slot, zero, zero, zero))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache.v, v_new.astype(cache.v.dtype),
+            (zero, slot, zero, zero, zero))
+        lengths = cache.lengths.at[slot].set(
+            jnp.asarray(prompt_len, jnp.int32))
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths)
+
+    def forward_decode(self, tokens, cache: StaticKVCache, active):
+        """One decode step for every slot: append ``tokens [B]`` at each
+        slot's current length, run the fused single-token attention per
+        layer, and advance ``lengths`` by ``active [B]`` (0/1 — retired
+        or empty slots keep their length; their writes land at a masked
+        position and their outputs are ignored by the scheduler).
+        Returns ``(hidden [B, 1, H], cache)``."""
+        cfg = self.cfg
+        b = cache.batch_slots
+        toks = tokens.data if isinstance(tokens, Tensor) \
+            else jnp.asarray(tokens)
+        pos = jnp.minimum(cache.lengths, cfg.max_seq_len - 1)
+        x = self.wte(Tensor(toks.reshape(b, 1))) + \
+            self.wpe(Tensor(pos.reshape(b, 1)))
+        x = self.drop(x)
+        cache_k, cache_v = cache.k, cache.v
+        for i, blk in enumerate(self.blocks):
+            x, k_layer, v_layer = blk.forward_decode(
+                x, cache_k[i], cache_v[i], cache.lengths)
+            cache_k = cache_k.at[i].set(k_layer)
+            cache_v = cache_v.at[i].set(v_layer)
+        lengths = jnp.minimum(
+            cache.lengths + jnp.asarray(active, jnp.int32),
+            cache.capacity)
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths)
+
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.recompute import recompute as _rc
         s = input_ids.shape[1]
@@ -454,6 +755,68 @@ class GPTForCausalLM(Layer):
         else:
             logits = self.lm_head(x)
         return logits
+
+    # ---- serving path -------------------------------------------------
+    def init_kv_cache(self, batch_slots: int, capacity: Optional[int] = None,
+                      dtype=None) -> StaticKVCache:
+        return self.gpt.init_kv_cache(batch_slots, capacity, dtype)
+
+    def _head_logits(self, hidden):
+        """hidden Tensor [..., H] -> logits Tensor [..., V]."""
+        if self.cfg.tie_word_embeddings:
+            return matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(hidden)
+
+    def prefill(self, input_ids, cache: StaticKVCache, slot, prompt_len):
+        """Prefill one slot; returns ``(logits [1, V], cache)`` — the
+        logits of the LAST real prompt token (position prompt_len-1),
+        i.e. the distribution of the first generated token."""
+        h, cache = self.gpt.forward_prefill(input_ids, cache, slot,
+                                            prompt_len)
+        harr = h.data                                     # [1, s, H]
+        last = jax.lax.dynamic_slice(
+            harr, (jnp.asarray(0, jnp.int32),
+                   jnp.asarray(prompt_len, jnp.int32) - 1,
+                   jnp.asarray(0, jnp.int32)),
+            (1, 1, harr.shape[-1]))[:, 0]                 # [1, H]
+        logits = self._head_logits(Tensor(last))
+        return logits.data, cache
+
+    def decode_step(self, tokens, cache: StaticKVCache, active):
+        """One decode step for all slots; returns
+        ``(logits [B, V], cache)``."""
+        h, cache = self.gpt.forward_decode(tokens, cache, active)
+        logits = self._head_logits(h)                     # [B, 1, V]
+        return logits.data[:, 0], cache
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 include_prompt: bool = False):
+        """Single-request convenience wrapper over the serving engine
+        (inference.engine.InferenceEngine): prefill the prompt, decode
+        greedily (temperature=0) or by temperature/top-k/top-p sampling,
+        stop at ``eos_id``/``max_new_tokens``.  Returns a 1-D numpy
+        array of generated token ids.
+
+        Builds a 1-slot engine per call (compiles on first use; the
+        persistent compile cache makes repeat processes cheap).  For
+        throughput serving use InferenceEngine directly.
+        """
+        from ..inference.engine import InferenceEngine
+        ids = np.asarray(
+            input_ids.numpy() if isinstance(input_ids, Tensor)
+            else input_ids).reshape(-1).astype(np.int32)
+        eng = InferenceEngine(self, batch_slots=1,
+                              top_k=top_k, seed=seed)
+        rid = eng.add_request(ids, max_new_tokens=max_new_tokens,
+                              eos_id=eos_id, temperature=temperature,
+                              top_p=top_p)
+        outs = eng.run()
+        gen = np.asarray(outs[rid], np.int32)
+        if include_prompt:
+            return np.concatenate([ids, gen])
+        return gen
 
 
 class GPTEmbeddingStage(Layer):

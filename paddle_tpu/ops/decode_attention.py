@@ -1,0 +1,176 @@
+"""Pallas TPU fused single-token decode attention over a static KV cache.
+
+The serving hot loop (inference.engine) appends ONE token per slot per
+step and attends it against a preallocated, fixed-capacity cache
+``[batch_slots, max_seq, kv_heads, head_dim]`` whose per-slot occupancy
+is a ``lengths`` vector.  Decode attention is memory-bound — the whole
+cost is streaming the KV cache through the chip once — so the fusion
+target is different from training flash attention: there is no softmax
+tiling problem (one query row), the win is reading each K/V block from
+HBM exactly once and never materializing the [B, H, S] score matrix or
+a repeat_interleaved K/V for GQA.
+
+Kernel shape: grid ``(B·Hkv,)``; each program holds the slot's query
+group ``[G, D]`` (G = H/Hkv query heads sharing one KV head) in VMEM and
+streams the slot's ``[S, D]`` K/V strips block by block with a running
+online-softmax max/denominator, masking key positions ``>= lengths[b]``.
+Like ``flash_attention.py`` the mask rides in as an f32 ``[B, 1, S]``
+strip (1 = valid) — trivially cheap next to the cache itself and it
+keeps the kernel free of SMEM scalar plumbing.
+
+The XLA composite (`_decode_composite`) is the CPU/fallback path and the
+ground truth for the kernel tests; both use f32 score accumulation.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+import importlib
+
+# the package __init__ rebinds the name `flash_attention` to the public
+# FUNCTION; fetch the sibling module itself (its _INTERPRET flag is
+# mutable state we must read live)
+_fa = importlib.import_module(__package__ + ".flash_attention")
+
+__all__ = ["decode_attention", "decode_attention_available",
+           "set_interpret_mode"]
+
+_NEG = -1e30
+_STATE = {"interpret": None}  # None = follow flash_attention's flag
+
+
+def set_interpret_mode(flag):
+    """True/False force interpret mode; None follows
+    flash_attention.set_interpret_mode (so one test switch drives both
+    kernels)."""
+    _STATE["interpret"] = flag
+
+
+def _interpret() -> bool:
+    if _STATE["interpret"] is not None:
+        return bool(_STATE["interpret"])
+    return _fa._INTERPRET
+
+
+def decode_attention_available() -> bool:
+    if not _fa._HAS_PLTPU:
+        return False
+    if _interpret():
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, block_k: int,
+                   scale: float):
+    """One (b·hkv) program: q_ref [G, D] query group; k/v [S, D] cache
+    strips; m_ref (1, S) f32 validity; o_ref [G, D]."""
+    g, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+
+    # storage-dtype (bf16) MXU inputs, f32 accumulation — the same mixed
+    # scheme as the training flash kernel
+    q = q_ref[:]
+
+    m0 = jnp.full((g, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        sblk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [g, bk] f32
+        kv_f = m_ref[0, pl.ds(j * block_k, block_k)]        # (bk,) f32
+        sblk = jnp.where(kv_f[None, :] > 0, sblk, _NEG)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        p = jnp.where(sblk <= _NEG / 2, 0.0, p)  # fully-masked blocks
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _decode_gqa(q3, k3, v3, mask, block_k=512):
+    """q3 [B·Hkv, G, D]; k3/v3 [B·Hkv, S, D]; mask [B, 1, S] f32."""
+    bhkv, g, d = q3.shape
+    s = k3.shape[1]
+    hkv = bhkv // mask.shape[0]
+    block_k = _fa._pick_block(s, block_k)
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(bhkv,),
+        in_specs=[
+            pl.BlockSpec((None, g, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s),
+                         lambda b, hkv=hkv: (b // hkv, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, g, d), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhkv, g, d), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, mask)
+
+
+def _decode_composite(q, k_cache, v_cache, lengths):
+    """XLA reference math. q [B, H, D]; caches [B, S, Hkv, D]; lengths
+    [B] int32 (valid tokens per slot, INCLUDING the one just written)."""
+    b, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, hkv, g, d)
+    kh = jnp.swapaxes(k_cache, 1, 2)                 # [b, hkv, s, d]
+    vh = jnp.swapaxes(v_cache, 1, 2)
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg, kh,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    valid = jnp.arange(s)[None, None, None, :] < \
+        lengths.astype(jnp.int32)[:, None, None, None]
+    scores = jnp.where(valid, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bksd->bkgd", probs, vh)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths):
+    """Single-token attention over a static, length-masked KV cache.
+
+    q ``[B, H, D]`` — the new token's query per slot; k_cache/v_cache
+    ``[B, S, Hkv, D]`` — fixed-capacity cache AFTER the new token's k/v
+    were written; lengths ``[B]`` int32 — valid tokens per slot
+    (including the new one).  Returns ``[B, H, D]``.  GQA is native
+    (H % Hkv == 0, grouped ``h = hk·G + g`` like flash_attention).
+    Pallas fused kernel when shapes allow, XLA composite otherwise.
+    """
+    b, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    supported = (s % 128 == 0 and (d % 128 == 0 or d == 64)
+                 and h % hkv == 0)
+    if not supported or not decode_attention_available():
+        return _decode_composite(q, k_cache, v_cache, lengths)
+    mask = (jnp.arange(s)[None, :] <
+            lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
+    k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
+    v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
+    o3 = _decode_gqa(q3, k3, v3, mask.reshape(b, 1, s))
+    return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)

@@ -14,12 +14,13 @@ from .utils import (  # noqa: F401
 from .datasets import (  # noqa: F401
     UCIHousing, Imdb, Imikolov, Movielens, WMT14, Conll05st, WMT16)
 from .decoding import (  # noqa: F401
-    beam_search, greedy_search, gather_tree, viterbi_decode)
+    beam_search, greedy_search, gather_tree, gpt_step_fn,
+    viterbi_decode)
 
 __all__ = [
     "sequence_mask", "pad_sequences", "truncate_sequences",
     "shift_tokens_right", "causal_mask", "padding_attn_mask",
     "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14", "WMT16",
     "Conll05st", "beam_search", "greedy_search", "gather_tree",
-    "viterbi_decode",
+    "gpt_step_fn", "viterbi_decode",
 ]

@@ -6,12 +6,20 @@ beam_search_decode_op (backtracking), gather_tree_op.cc, and the Python
 orchestration in fluid/layers/rnn.py (BeamSearchDecoder +
 dynamic_decode).
 
-TPU-native shape: the whole decode is ONE lax.scan over time — the
-per-step top-k, parent gather, and finished masking are fixed-shape jnp
-ops, so the entire loop compiles to a single XLA while-program (the
-reference re-enters the executor per step).  States carry a leading
-[B*K] dim; `step_fn(tokens, state) -> (logits, state)` is any jax
-function (e.g. a transformer step with a KV cache pytree).
+TPU-native shape: the whole decode is ONE `lax.while_loop` over time —
+the per-step top-k, parent gather, and finished masking are fixed-shape
+jnp ops writing into preallocated [max_len, ...] buffers, so the entire
+loop compiles to a single XLA while-program (the reference re-enters
+the executor per step) AND exits early: once every batch row / beam has
+emitted EOS the loop stops instead of burning the remaining max_len
+steps (the buffers are EOS/identity-filled, so outputs are identical to
+the full-length run).  States carry a leading [B*K] dim;
+`step_fn(tokens, state) -> (logits, state)` is any jax function.
+
+`gpt_step_fn` adapts a models.GPTForCausalLM + its StaticKVCache to
+that contract (the cache's [layers, N, ...] leaves are re-gathered on
+axis 1 by the beam parent shuffle), which is what wires these decoders
+to the real transformer decode step.
 """
 from __future__ import annotations
 
@@ -25,9 +33,23 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, unwrap as _arr
 
 __all__ = ["beam_search", "greedy_search", "gather_tree",
-           "viterbi_decode"]
+           "viterbi_decode", "gpt_step_fn"]
 
 _NEG = -1e9
+
+
+def gpt_step_fn(model) -> Callable:
+    """step_fn over a GPTForCausalLM: ``step(tokens [N], cache) ->
+    (logits [N, V], cache)`` where cache is a models.StaticKVCache with
+    N slots (``model.init_kv_cache(N)``, optionally pre-filled with a
+    prompt per slot via ``model.prefill``).  Every step appends one
+    token per slot — recompile-free by construction.  Call
+    ``model.eval()`` first so dropout layers are inert."""
+    def step(tokens, cache):
+        active = jnp.ones((cache.batch_slots,), jnp.int32)
+        logits, cache = model.decode_step(tokens, cache, active)
+        return logits, cache
+    return step
 
 
 
@@ -68,8 +90,34 @@ def beam_search(step_fn: Callable, init_state, batch_size: int,
     def expand_logp(logits):
         return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
-    def step(carry, _):
-        tokens, cum, finished, state = carry      # [B,K], [B,K], [B,K]
+    def regather(a, parent):
+        """Shuffle a state leaf by beam parents.  Leaves with a leading
+        [B*K] dim gather on axis 0; [L, B*K, ...] leaves (a
+        StaticKVCache's stacked-layer k/v) gather on axis 1.  (A leaf
+        whose axis-0 length coincidentally equals B*K takes the axis-0
+        branch — lay out such state batch-first.)"""
+        if a.ndim >= 1 and a.shape[0] == B * K:
+            r = a.reshape((B, K) + a.shape[1:])[
+                jnp.arange(B)[:, None], parent]
+            return r.reshape((B * K,) + a.shape[1:])
+        if a.ndim >= 2 and a.shape[1] == B * K:
+            r = a.reshape((a.shape[0], B, K) + a.shape[2:])[
+                :, jnp.arange(B)[:, None], parent]
+            return r.reshape((a.shape[0], B * K) + a.shape[2:])
+        raise ValueError(
+            f"beam_search state leaf {a.shape} carries no [B*K]={B * K} "
+            f"dim on axis 0 or 1")
+
+    def cond(carry):
+        t, _, _, finished, _, _, _ = carry
+        # EOS early-exit: the while-program stops the moment every beam
+        # of every row has finished (the scan version always paid
+        # max_len steps; the buffers are EOS/identity-initialized so
+        # the output is bit-identical)
+        return (t < max_len) & ~jnp.all(finished)
+
+    def step(carry):
+        t, tokens, cum, finished, state, toks_buf, par_buf = carry
         logits, state = step_fn(tokens.reshape(-1), state)
         V = logits.shape[-1]
         logp = expand_logp(logits).reshape(B, K, V)
@@ -85,20 +133,27 @@ def beam_search(step_fn: Callable, init_state, batch_size: int,
         token = idx % V
         finished = jnp.take_along_axis(finished, parent, axis=1) | \
             (token == eos_id)
-        state = jax.tree_util.tree_map(
-            lambda a: a.reshape((B, K) + a.shape[1:])[
-                jnp.arange(B)[:, None], parent].reshape(
-                    (B * K,) + a.shape[1:]),
-            state)
-        return (token, cum_new, finished, state), (token, parent)
+        state = jax.tree_util.tree_map(lambda a: regather(a, parent),
+                                       state)
+        toks_buf = toks_buf.at[t].set(token)
+        par_buf = par_buf.at[t].set(parent)
+        return (t + 1, token, cum_new, finished, state, toks_buf,
+                par_buf)
 
     tokens0 = jnp.full((B, K), bos_id, jnp.int32)
     # only beam 0 is live at t=0, or every beam would decode identically
     cum0 = jnp.tile(jnp.asarray([0.0] + [_NEG] * (K - 1),
                                 jnp.float32)[None, :], (B, 1))
     fin0 = jnp.zeros((B, K), bool)
-    (tokens, cum, finished, _), (toks, parents) = jax.lax.scan(
-        step, (tokens0, cum0, fin0, init_state), None, length=max_len)
+    # unexecuted steps: eos tokens with identity parents, so gather_tree
+    # backtracks through them unchanged
+    toks0 = jnp.full((max_len, B, K), eos_id, jnp.int32)
+    par0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, None, :],
+                            (max_len, B, K))
+    _, tokens, cum, finished, _, toks, parents = jax.lax.while_loop(
+        cond, step,
+        (jnp.asarray(0, jnp.int32), tokens0, cum0, fin0, init_state,
+         toks0, par0))
 
     seqs = gather_tree(toks, parents).data        # [T, B, K]
     seqs = jnp.moveaxis(seqs, 0, 2)               # [B, K, T]
@@ -120,21 +175,30 @@ def beam_search(step_fn: Callable, init_state, batch_size: int,
 def greedy_search(step_fn: Callable, init_state, batch_size: int,
                   max_len: int, bos_id: int, eos_id: int
                   ) -> Tensor:
-    """Greedy argmax decode as one lax.scan. Returns [B, max_len]."""
+    """Greedy argmax decode as one XLA while-program with EOS
+    early-exit: the loop stops once every row has finished (the output
+    buffer is EOS-filled, so results match the full-length run).
+    Returns [B, max_len]."""
     B = batch_size
 
-    def step(carry, _):
-        tokens, finished, state = carry
+    def cond(carry):
+        t, _, finished, _, _ = carry
+        return (t < max_len) & ~jnp.all(finished)
+
+    def step(carry):
+        t, tokens, finished, state, out = carry
         logits, state = step_fn(tokens, state)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(finished, eos_id, nxt)
         finished = finished | (nxt == eos_id)
-        return (nxt, finished, state), nxt
+        return t + 1, nxt, finished, state, out.at[t].set(nxt)
 
     tokens0 = jnp.full((B,), bos_id, jnp.int32)
     fin0 = jnp.zeros((B,), bool)
-    _, toks = jax.lax.scan(step, (tokens0, fin0, init_state), None,
-                           length=max_len)
+    out0 = jnp.full((max_len, B), eos_id, jnp.int32)
+    _, _, _, _, toks = jax.lax.while_loop(
+        cond, step,
+        (jnp.asarray(0, jnp.int32), tokens0, fin0, init_state, out0))
     return Tensor(jnp.moveaxis(toks, 0, 1))
 
 

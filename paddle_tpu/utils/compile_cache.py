@@ -24,14 +24,33 @@ when the cache backend misbehaves).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Optional
 
 __all__ = ["ensure_compile_cache", "compile_cache_dir",
-           "compile_cache_enabled"]
+           "compile_cache_enabled", "suspend_cpu_cache_hits"]
 
 _STATE: dict = {"resolved": False, "dir": None}
+_SUSPEND = {"depth": 0}
 _OFF_VALUES = ("0", "off", "false", "none", "disabled")
+
+
+@contextlib.contextmanager
+def suspend_cpu_cache_hits():
+    """While active, the CPU-backend guard refuses ALL persistent-cache
+    hits (entries are still WRITTEN, so nothing is lost for later TPU
+    runs).  Used when compiling executables with DONATED operands on the
+    CPU backend: jaxlib 0.4.x mis-aliases donated buffers in executables
+    deserialized from the persistent cache (the hazard PR 2 hit with
+    rollback; the serving engine's decode executable donates its KV
+    cache the same way) — compiling fresh is the dodge.  No-op on TPU.
+    """
+    _SUSPEND["depth"] += 1
+    try:
+        yield
+    finally:
+        _SUSPEND["depth"] -= 1
 
 
 def _default_dir() -> str:
@@ -54,6 +73,11 @@ def _install_cpu_spmd_guard() -> None:
     def _guarded_get(cache_key, compile_options, backend):
         try:
             if getattr(backend, "platform", "cpu") == "cpu":
+                if _SUSPEND["depth"] > 0:
+                    # donated-operand executable being built (serving
+                    # engine decode/prefill): deserializing those on CPU
+                    # mis-aliases the donation — force a fresh compile
+                    return None, None
                 ebo = compile_options.executable_build_options
                 if ebo.num_partitions > 1 or ebo.num_replicas > 1:
                     return None, None

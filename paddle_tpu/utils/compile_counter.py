@@ -1,0 +1,103 @@
+"""Process-wide XLA compile/trace counters.
+
+PR 3 added the host-sync counter (distributed.async_dispatch) so tests
+could PROVE "no per-step read-back" instead of hand-waving it; this is
+the same discipline for compilation.  The serving engine's contract is
+"the decode loop is recompile-free": after warmup, generating N tokens
+must trigger ZERO new XLA compilations (a shape that changes per token —
+the old concat-grown KV cache — would show up here as one compile per
+generated token).
+
+Counting uses ``jax.monitoring``, which jax fires around its own
+compilation pipeline:
+
+- ``/jax/core/compile/backend_compile_duration`` — one event per REAL
+  XLA backend compile (persistent-cache deserializations do not fire it);
+- ``/jax/core/compile/jaxpr_trace_duration`` — one event per jaxpr
+  trace.  A persistent-cache hit still traces+lowers, so a decode loop
+  whose shapes wobble is caught by the trace counter even when a warm
+  on-disk cache hides the backend compile.
+
+Listeners are registered lazily and exactly once; jax keeps them for the
+process lifetime (there is no unregister-by-context), so the counters
+are monotone — bracket a region with ``snapshot()`` and subtract.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["install", "xla_compile_count", "xla_trace_count",
+           "compile_counts", "CompileCountSnapshot", "snapshot"]
+
+_lock = threading.Lock()
+_STATE = {"installed": False, "compiles": 0, "traces": 0}
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+
+def _listener(key: str, duration: float, **kwargs) -> None:
+    if key == _COMPILE_EVENT:
+        with _lock:
+            _STATE["compiles"] += 1
+    elif key == _TRACE_EVENT:
+        with _lock:
+            _STATE["traces"] += 1
+
+
+def install() -> bool:
+    """Register the monitoring listener (idempotent). Returns True when
+    the counters are live, False when jax's monitoring API is missing
+    (counters then stay at 0 — callers must treat 0-delta as 'no
+    evidence of a recompile', which is still the correct assertion
+    direction for the recompile-free contract)."""
+    with _lock:
+        if _STATE["installed"]:
+            return True
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_duration_secs_listener(_listener)
+        except Exception:  # pragma: no cover - jax internals moved
+            return False
+        _STATE["installed"] = True
+        return True
+
+
+def xla_compile_count() -> int:
+    """Total XLA backend compiles observed in this process."""
+    install()
+    return _STATE["compiles"]
+
+
+def xla_trace_count() -> int:
+    """Total jaxpr traces observed in this process."""
+    install()
+    return _STATE["traces"]
+
+
+def compile_counts() -> dict:
+    install()
+    with _lock:
+        return {"xla_compiles": _STATE["compiles"],
+                "jaxpr_traces": _STATE["traces"]}
+
+
+class CompileCountSnapshot:
+    """Bracketing helper: ``snap = snapshot(); ...; snap.new_compiles``."""
+
+    def __init__(self):
+        install()
+        self._c0 = _STATE["compiles"]
+        self._t0 = _STATE["traces"]
+
+    @property
+    def new_compiles(self) -> int:
+        return _STATE["compiles"] - self._c0
+
+    @property
+    def new_traces(self) -> int:
+        return _STATE["traces"] - self._t0
+
+
+def snapshot() -> CompileCountSnapshot:
+    return CompileCountSnapshot()

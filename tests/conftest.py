@@ -44,6 +44,11 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 # serve cache hits only for 1-partition/1-replica programs; SPMD
 # programs always recompile (their entries are still written, so
 # nothing else regresses if a future jaxlib fixes deserialization).
+# The guard also honors compile_cache.suspend_cpu_cache_hits(): the
+# serving engine (inference.engine) brackets DONATED prefill/decode
+# compiles with it on CPU, because deserialized executables mis-alias
+# donated operands on this jaxlib (PR 2's rollback hazard) — that is
+# what lets the engine tests run safely under this suite's warm cache.
 from paddle_tpu.utils.compile_cache import \
     _install_cpu_spmd_guard  # noqa: E402
 

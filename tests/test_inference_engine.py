@@ -1,0 +1,459 @@
+"""Serving engine tests: static KV cache, fused decode attention,
+continuous batching, and the recompile-free-decode contract.
+
+Ground truth throughout is the ordinary full forward: prefill(k tokens)
++ N decode steps over the static cache must reproduce the logits a
+single forward over the whole sequence produces (exact in f32 on CPU;
+the tolerance argument covers bf16 on TPU).  The compile-count
+assertions use utils.compile_counter (the PR 3-style counter
+discipline: prove it, don't hand-wave it).
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, StaticKVCache
+from paddle_tpu.inference import InferenceEngine, default_prefill_buckets
+from paddle_tpu.distributed import async_dispatch
+from paddle_tpu.utils import compile_counter
+
+da = importlib.import_module("paddle_tpu.ops.decode_attention")
+
+TINY = dict(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=64, use_flash_attention=False)
+
+
+def tiny_model(**over):
+    paddle.seed(0)
+    cfg = GPTConfig(**{**TINY, **over})
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return tiny_model()
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    """Shared 2-slot engine: engines are stateless between completed
+    requests (slot lengths mask any stale cache rows), so sequential
+    tests can reuse one and skip ~5 redundant compiles."""
+    return InferenceEngine(model, batch_slots=2, prefill_buckets=[8])
+
+
+def naive_greedy(model, prompt, n):
+    """Argmax rollout with the ordinary full forward (no cache)."""
+    ids = list(np.asarray(prompt).reshape(-1))
+    outs = []
+    for _ in range(n):
+        lg = model(paddle.to_tensor(
+            np.asarray([ids], np.int32))).numpy()[0, -1]
+        t = int(np.argmax(lg))
+        outs.append(t)
+        ids.append(t)
+    return outs
+
+
+# ---- fused decode attention kernel ------------------------------------
+
+@pytest.mark.parametrize("hkv", [4, 2, 1])
+def test_decode_attention_kernel_matches_composite(hkv):
+    """Pallas kernel (interpret mode) vs XLA composite, incl. GQA and
+    per-slot length masking."""
+    da.set_interpret_mode(True)
+    try:
+        rng = np.random.RandomState(0)
+        b, s, h, d = 3, 256, 4, 64
+        q = jnp.asarray(rng.randn(b, h, d).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.3)
+        lengths = jnp.asarray([1, 100, 256], jnp.int32)
+        out = da.decode_attention(q, k, v, lengths)
+        ref = da._decode_composite(q, k, v, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        da.set_interpret_mode(None)
+
+
+def test_decode_attention_length_masks_tail():
+    """Garbage beyond lengths[b] must not leak into the output."""
+    rng = np.random.RandomState(1)
+    b, s, hkv, d = 2, 128, 2, 16
+    q = jnp.asarray(rng.randn(b, 4, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32))
+    lengths = jnp.asarray([5, 9], jnp.int32)
+    base = np.asarray(da._decode_composite(q, k, v, lengths))
+    poisoned_k = k.at[:, 10:].set(1e3)
+    poisoned_v = v.at[:, 10:].set(-1e3)
+    out = np.asarray(da._decode_composite(q, poisoned_k, poisoned_v,
+                                          lengths))
+    np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-6)
+
+
+# ---- static cache vs full forward -------------------------------------
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_prefill_plus_decode_matches_full_forward(kv_heads):
+    """prefill(7 tokens) + 4 decode steps == one forward over 11 tokens
+    (logit parity at every generated position; GQA covered)."""
+    m = tiny_model(num_kv_heads=kv_heads)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 97, (1, 11)).astype(np.int32)
+    full = np.asarray(m(paddle.to_tensor(ids)).data)        # [1, 11, V]
+
+    cache = m.init_kv_cache(batch_slots=3)
+    logits, cache = m.prefill(jnp.asarray(ids[:, :7]), cache, 1, 7)
+    np.testing.assert_allclose(np.asarray(logits)[0], full[0, 6],
+                               rtol=1e-4, atol=1e-4)
+    for t in range(7, 11):
+        toks = np.zeros(3, np.int32)
+        toks[1] = ids[0, t]
+        active = jnp.asarray([0, 1, 0], jnp.int32)
+        lg, cache = m.decode_step(jnp.asarray(toks), cache, active)
+        np.testing.assert_allclose(np.asarray(lg)[1], full[0, t],
+                                   rtol=1e-4, atol=1e-4)
+    assert np.asarray(cache.lengths).tolist() == [0, 11, 0]
+
+
+def test_bucket_padding_is_masked():
+    """Prefill through a padded bucket (prompt 5 in a 16-bucket) must
+    produce the same logits as the exact-length prefill."""
+    m = tiny_model()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, 97, (5,)).astype(np.int32)
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :5] = prompt
+    c1 = m.init_kv_cache(1)
+    l1, c1 = m.prefill(jnp.asarray(prompt[None]), c1, 0, 5)
+    c2 = m.init_kv_cache(1)
+    l2, c2 = m.prefill(jnp.asarray(padded), c2, 0, 5)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-4, atol=1e-4)
+    # and the first decode step agrees too (pad k/v stay masked)
+    tok = jnp.asarray([3], jnp.int32)
+    act = jnp.ones((1,), jnp.int32)
+    d1, _ = m.decode_step(tok, c1, act)
+    d2, _ = m.decode_step(tok, c2, act)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---- legacy tuple-cache API -------------------------------------------
+
+def test_legacy_cache_fresh_matches_no_cache():
+    m = tiny_model()
+    attn = m.gpt.blocks[0].attn
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 5, 64).astype(np.float32))
+    out_plain = attn(x)
+    out_cached, triple = attn(x, cache=(None, None))
+    np.testing.assert_allclose(out_plain.numpy(), out_cached.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    k_buf, v_buf, length = triple
+    assert k_buf.shape == (2, 64, 4, 16) and length == 5
+
+
+def test_legacy_cache_decode_matches_full():
+    """Old-style incremental decode through the tuple cache equals the
+    full-sequence attention at the last position."""
+    m = tiny_model()
+    attn = m.gpt.blocks[0].attn
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 64).astype(np.float32)
+    full = attn(paddle.to_tensor(x)).numpy()
+    out, cache = attn(paddle.to_tensor(x[:, :3]), cache=(None, None))
+    for t in range(3, 6):
+        out, cache = attn(paddle.to_tensor(x[:, t:t + 1]), cache=cache)
+        np.testing.assert_allclose(out.numpy()[:, 0], full[:, t],
+                                   rtol=1e-4, atol=1e-4)
+    assert cache[0].shape == (2, 64, 4, 16)   # capacity never grew
+
+
+def test_legacy_cache_adopts_dense_past():
+    """A legacy 2-tuple of dense past k/v is adopted into the fixed
+    buffer: next-step output equals the full-sequence reference."""
+    m = tiny_model()
+    attn = m.gpt.blocks[0].attn
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 5, 64).astype(np.float32)
+    full = attn(paddle.to_tensor(x)).numpy()
+    # build dense past k/v for the first 4 tokens by hand
+    q, k, v = attn._qkv_arrays(paddle.to_tensor(x[:, :4]))
+    out, cache = attn(paddle.to_tensor(x[:, 4:5]), cache=(k, v))
+    np.testing.assert_allclose(out.numpy()[:, 0], full[:, 4],
+                               rtol=1e-4, atol=1e-4)
+    assert cache[2] == 5
+
+
+def test_legacy_cache_overflow_raises_eagerly():
+    """Eager use past capacity must raise, not silently clamp (the old
+    concat cache grew unboundedly; the static buffer cannot)."""
+    m = tiny_model(max_seq_len=8)
+    attn = m.gpt.blocks[0].attn
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 6, 64).astype(np.float32)
+    _, cache = attn(paddle.to_tensor(x), cache=(None, None))
+    _, cache = attn(paddle.to_tensor(x[:, :2]), cache=cache)  # 8 == cap
+    with pytest.raises(ValueError, match="overflow"):
+        attn(paddle.to_tensor(x[:, :1]), cache=cache)
+
+
+def test_legacy_cache_decode_is_recompile_free():
+    """The fixed-capacity tuple cache keeps shapes static: N jitted
+    decode steps = ONE trace/compile (the old concat cache recompiled
+    every token)."""
+    m = tiny_model()
+    attn = m.gpt.blocks[0].attn
+    rng = np.random.RandomState(5)
+    step = jax.jit(lambda xt, cache: attn(paddle.Tensor(xt),
+                                          cache=cache))
+    x0 = jnp.asarray(rng.randn(1, 1, 64).astype(np.float32))
+    out, cache = step(x0, (jnp.zeros((1, 64, 4, 16), jnp.float32),
+                           jnp.zeros((1, 64, 4, 16), jnp.float32),
+                           jnp.asarray(0, jnp.int32)))
+    snap = compile_counter.snapshot()
+    for _ in range(6):
+        out, cache = step(
+            jnp.asarray(rng.randn(1, 1, 64).astype(np.float32)), cache)
+    assert snap.new_compiles == 0 and snap.new_traces == 0
+    assert int(cache[2]) == 7
+
+
+# ---- engine -----------------------------------------------------------
+
+def test_engine_greedy_matches_naive_rollout(model, engine):
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 97, (5,)).astype(np.int32)
+    ref = naive_greedy(model, prompt, 6)
+    rid = engine.add_request(prompt, max_new_tokens=6)
+    outs = engine.run()
+    assert outs[rid].tolist() == ref
+
+
+def test_engine_decode_is_recompile_free(model, engine):
+    """THE acceptance criterion: after warmup, generating N tokens
+    triggers 0 new XLA compiles AND 0 new jaxpr traces."""
+    engine.warmup(buckets=[8])
+    rng = np.random.RandomState(1)
+    # one full request through prefill+decode to flush any lazy host-side
+    # one-offs, then the counted window
+    engine.add_request(rng.randint(1, 97, (4,)).astype(np.int32),
+                       max_new_tokens=2)
+    engine.run()
+    snap = compile_counter.snapshot()
+    sync0 = async_dispatch.host_sync_count()
+    rid = engine.add_request(rng.randint(1, 97, (5,)).astype(np.int32),
+                             max_new_tokens=10)
+    outs = engine.run()
+    assert len(outs[rid]) == 10
+    assert snap.new_compiles == 0, \
+        f"{snap.new_compiles} XLA compiles during the decode window"
+    assert snap.new_traces == 0, \
+        f"{snap.new_traces} jaxpr traces during the decode window"
+    # sync budget: 1 per decode step (token read-back) + 1 per admission
+    st = engine.stats
+    syncs = async_dispatch.host_sync_count() - sync0
+    assert syncs <= 10, f"{syncs} host syncs for a 10-token request"
+    assert st["xla_compiles"] >= 0  # counter alive
+
+
+def test_engine_continuous_batching_isolation(model, engine):
+    """Admitting B mid-stream must not perturb A's tokens (slot-local
+    prefill writes), and both requests complete."""
+    rng = np.random.RandomState(7)
+    pA = rng.randint(1, 97, (4,)).astype(np.int32)
+    pB = rng.randint(1, 97, (6,)).astype(np.int32)
+
+    ra = engine.add_request(pA, max_new_tokens=10)
+    solo = engine.run()[ra].tolist()
+
+    ra = engine.add_request(pA, max_new_tokens=10)
+    for _ in range(3):
+        engine.step()
+    rb = engine.add_request(pB, max_new_tokens=5)
+    res = engine.run()
+    assert res[ra].tolist() == solo
+    assert len(res[rb]) == 5
+    assert res[rb].tolist() == naive_greedy(model, pB, 5)
+
+
+def test_engine_queue_overflow_waits(engine):
+    """More requests than slots: the queue drains as slots retire."""
+    rng = np.random.RandomState(8)
+    rids = [engine.add_request(rng.randint(1, 97, (3,)).astype(np.int32),
+                               max_new_tokens=3) for _ in range(5)]
+    res = engine.run()
+    assert all(r in res for r in rids)
+    assert all(len(res[r]) == 3 for r in rids)
+
+
+def test_engine_eos_retirement(model, engine):
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(1, 97, (4,)).astype(np.int32)
+    first = naive_greedy(model, prompt, 1)[0]
+    rid = engine.add_request(prompt, max_new_tokens=50, eos_id=first)
+    res = engine.run()
+    assert res[rid].tolist() == [first]       # stopped at EOS, slot freed
+    assert engine.num_active == 0
+
+
+def test_engine_sampling_deterministic_and_topk1_greedy(model):
+    rng = np.random.RandomState(10)
+    prompt = rng.randint(1, 97, (4,)).astype(np.int32)
+    sampled = []
+    for _ in range(2):
+        eng = InferenceEngine(model, batch_slots=1, prefill_buckets=[8],
+                              seed=42)
+        r = eng.add_request(prompt, max_new_tokens=8, temperature=0.9,
+                            top_p=0.95)
+        sampled.append(eng.run()[r].tolist())
+    assert sampled[0] == sampled[1]           # same seed, same stream
+    eng = InferenceEngine(model, batch_slots=1, prefill_buckets=[8],
+                          seed=7, top_k=1)
+    r = eng.add_request(prompt, max_new_tokens=6, temperature=1.3)
+    assert eng.run()[r].tolist() == naive_greedy(model, prompt, 6)
+
+
+def test_engine_stats_fields(engine):
+    r = engine.add_request(np.asarray([5, 6, 7], np.int32),
+                           max_new_tokens=4)
+    engine.run()
+    st = engine.stats
+    for key in ("prefill_ms", "decode_ms", "compile_ms_cold",
+                "decode_steps", "tokens_generated", "slot_occupancy",
+                "decode_tokens_per_sec", "xla_compiles", "jaxpr_traces",
+                "batch_slots", "buckets"):
+        assert key in st, key
+    assert st["tokens_generated"] >= 3
+    assert 0 < st["slot_occupancy"] <= 1
+    assert r in engine.results
+
+
+def test_generate_wrapper(model):
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 97, (5,)).astype(np.int32)
+    out = model.generate(prompt, max_new_tokens=5)
+    assert out.tolist() == naive_greedy(model, prompt, 5)
+    both = model.generate(prompt, max_new_tokens=3, include_prompt=True)
+    assert both[:5].tolist() == prompt.tolist()
+
+
+def test_default_prefill_buckets(model):
+    assert default_prefill_buckets(64, lo=16) == [16, 32, 64]
+    assert default_prefill_buckets(100, lo=16) == [16, 32, 64, 100]
+    eng = InferenceEngine(model, batch_slots=1)   # no jit runs: cheap
+    with pytest.raises(ValueError):
+        eng.add_request(np.ones(65, np.int32))  # beyond largest bucket
+
+
+# ---- decoding wiring + EOS early-exit ---------------------------------
+
+@pytest.fixture(scope="module")
+def wiring_model():
+    return tiny_model(vocab_size=50, hidden_size=32, num_heads=2)
+
+
+def test_gpt_greedy_search_matches_naive(wiring_model):
+    from paddle_tpu.text import greedy_search, gpt_step_fn
+    m = wiring_model
+    step = gpt_step_fn(m)
+    cache = m.init_kv_cache(2)
+    toks = np.asarray(greedy_search(step, cache, 2, 6, bos_id=1,
+                                    eos_id=0).data)
+    ref = naive_greedy(m, [1], 6)
+    stop = ref.index(0) + 1 if 0 in ref else 6
+    assert toks[0].tolist()[:stop] == ref[:stop]
+    assert toks.shape == (2, 6)
+
+
+def test_gpt_beam_search_runs_over_cache_state(wiring_model):
+    from paddle_tpu.text import beam_search, gpt_step_fn
+    m = wiring_model
+    K = 3
+    cache = m.init_kv_cache(1 * K)
+    seqs, scores = beam_search(gpt_step_fn(m), cache, 1, K, 5,
+                               bos_id=1, eos_id=0)
+    assert seqs.shape == [1, K, 5]
+    sc = np.asarray(scores.data)[0]
+    assert all(sc[i] >= sc[i + 1] for i in range(K - 1))
+
+
+def _counting_lm(table):
+    """LM over a fixed next-token table + a host call counter."""
+    calls = []
+
+    def step_fn(tokens, state):
+        jax.debug.callback(lambda: calls.append(1))
+        return jnp.asarray(table)[tokens], state
+
+    return step_fn, calls
+
+
+def test_greedy_eos_early_exit():
+    """Once every row emits EOS the while-program stops: far fewer
+    step_fn executions than max_len."""
+    from paddle_tpu.text import greedy_search
+    V, EOS, BOS = 5, 0, 1
+    table = np.full((V, V), -5.0, np.float32)
+    table[:, EOS] = 5.0                      # everything points at EOS
+    step_fn, calls = _counting_lm(table)
+    toks = np.asarray(greedy_search(step_fn, (), 3, 50, BOS, EOS).data)
+    assert toks.shape == (3, 50)
+    assert (toks == EOS).all()
+    assert len(calls) <= 3, f"{len(calls)} steps for an instant-EOS LM"
+
+
+def test_beam_eos_early_exit_matches_full_run():
+    """Early exit must not change results: same sequences/scores as a
+    brute-force comparison LM where EOS arrives quickly."""
+    from paddle_tpu.text import beam_search
+    V, EOS, BOS = 5, 0, 1
+    # EOS overwhelms every alternative, so ALL K beams finish within a
+    # couple of steps and the while-program exits
+    table = np.full((V, V), -50.0, np.float32)
+    table[:, EOS] = 0.0
+    step_fn, calls = _counting_lm(table)
+    seqs, scores = beam_search(step_fn, (), 1, 3, 20, BOS, EOS)
+    assert np.asarray(seqs.data).shape == (1, 3, 20)
+    assert len(calls) <= 5, f"no early exit: {len(calls)} steps"
+    # every beam terminated with EOS and post-EOS positions are EOS
+    arr = np.asarray(seqs.data)[0]
+    for k in range(3):
+        row = arr[k].tolist()
+        assert EOS in row
+        first = row.index(EOS)
+        assert all(t == EOS for t in row[first:])
+
+
+# ---- long-sequence serve bench (slow) ---------------------------------
+
+@pytest.mark.slow
+def test_serve_bench_long_sequence():
+    """Longer-horizon engine soak: 6 requests, 512-capacity cache,
+    mixed admission; asserts steady-state decode stays compile-free."""
+    m = tiny_model(max_seq_len=512)
+    eng = InferenceEngine(m, batch_slots=4, max_seq_len=512,
+                          prefill_buckets=[32, 128])
+    eng.warmup(buckets=[32])
+    rng = np.random.RandomState(12)
+    rids = [eng.add_request(
+        rng.randint(1, 97, (rng.randint(3, 100),)).astype(np.int32),
+        max_new_tokens=40) for _ in range(6)]
+    for _ in range(3):
+        eng.step()
+    snap = compile_counter.snapshot()
+    res = eng.run()
+    assert snap.new_compiles == 0
+    assert sorted(res) == sorted(rids)
+    assert all(len(res[r]) == 40 for r in rids)
+    assert eng.stats["slot_occupancy"] > 0.5
