@@ -102,8 +102,14 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     # part of the measured step (skip compiles an extra finite-check +
     # select into the executable)
     anomaly_policy = os.environ.get("BENCH_ANOMALY_POLICY", "raise")
+    # collective breakdown (comm_ms/comm_fraction in the JSON): the AOT
+    # analysis re-lowers the step, but its XLA compile hits the
+    # persistent cache (identical HLO), so the steady-state cost is a
+    # deserialize; BENCH_COMM_STATS=0 drops it entirely
+    comm_stats = os.environ.get("BENCH_COMM_STATS", "1") != "0"
     trainer = SpmdTrainer(model, opt, lambda o, l: crit(o, l), mesh=mesh,
-                          strategy=st, anomaly_policy=anomaly_policy)
+                          strategy=st, anomaly_policy=anomaly_policy,
+                          comm_stats=comm_stats)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -219,7 +225,11 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
         "compile_cache_dir": cache_dir,
         **{k: trainer_stats[k] for k in
            ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
-            "compile_ms_cold", "steps_timed")},
+            "compile_ms_cold", "steps_timed",
+            # collective breakdown (None when BENCH_COMM_STATS=0 or the
+            # AOT analysis failed)
+            "comm_ms", "comm_fraction", "comm_bytes",
+            "comm_collectives")},
     }
 
 
@@ -454,6 +464,59 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
     print(json.dumps(out))
 
 
+def bench_multichip_child():
+    """Child half of --multichip-smoke (runs with JAX_PLATFORMS=cpu and
+    8 virtual host devices): executes the shared overlap-parity phases
+    and prints ONE JSON line.  Each phase asserts sync-vs-overlap loss
+    parity (rtol 1e-5), zero XLA recompiles across steps 2..N, and that
+    the new comm_ms/comm_fraction stats fields exist — a phase failure
+    exits non-zero."""
+    import time as _time
+    import jax
+    from paddle_tpu.testing import multichip
+
+    t0 = _time.perf_counter()
+    phases = []
+    for fn in (multichip.run_zero3_phase, multichip.run_1f1b_phase,
+               multichip.run_moe_a2a_phase):
+        r = fn()
+        phases.append(r)
+        log(f"  multichip phase {r['name']} ok t={r['t_s']}s")
+    out = {
+        "metric": "multichip_smoke", "ok": True,
+        "n_devices": len(jax.devices()),
+        "wall_s": round(_time.perf_counter() - t0, 1),
+        "overlap_env": os.environ.get("PADDLE_TPU_OVERLAP", "1"),
+        "parity_rtol": multichip.PARITY_RTOL,
+        "phases": phases,
+    }
+    print(json.dumps(out))
+
+
+def bench_multichip_smoke(n_devices=8):
+    """--multichip-smoke: re-exec this script on a virtual n-device CPU
+    mesh (XLA_FLAGS host-platform device count) and run the overlap
+    parity phases.  A subprocess is mandatory: jax is already imported
+    here, so device-count env flags can no longer take effect, and any
+    TPU-tunnel env (AXON vars) must be scrubbed exactly like the driver
+    dryrun does (__graft_entry__.dryrun_multichip round-4 root cause)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(kept)
+    for k in [k for k in env
+              if k.startswith(("AXON_", "PALLAS_AXON_", "TPU_"))]:
+        env.pop(k, None)
+    rc = subprocess.call(
+        [sys.executable, "-u", os.path.abspath(__file__),
+         "--multichip-child"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    if rc != 0:
+        raise SystemExit(rc)
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
@@ -464,7 +527,7 @@ def bench_smoke():
     regressions before a TPU bench ever runs."""
     required = ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
                 "compile_ms_cold", "steps_timed", "host_syncs_measured",
-                "prefetch_depth")
+                "prefetch_depth", "comm_ms", "comm_fraction")
     cold = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
                        use_flash=False, remat=False, smoke=True)
     missing = [k for k in required if k not in cold]
@@ -501,6 +564,14 @@ def main():
 
     if "--serve" in sys.argv:
         bench_serve(smoke="--smoke" in sys.argv)
+        return
+
+    if "--multichip-child" in sys.argv:
+        bench_multichip_child()
+        return
+
+    if "--multichip-smoke" in sys.argv:
+        bench_multichip_smoke()
         return
 
     if "--smoke" in sys.argv:

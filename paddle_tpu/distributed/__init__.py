@@ -38,3 +38,5 @@ from . import resilience  # noqa: F401
 from .resilience import CheckpointManager, PreemptionGuard  # noqa: F401
 from . import launch as launch_mod  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from . import overlap  # noqa: F401
+from .overlap import overlap_enabled, ensure_xla_overlap_flags  # noqa: F401

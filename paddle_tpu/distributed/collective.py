@@ -226,7 +226,18 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
                sync_op=True, axis_name=None):
     """All-to-all (ABSENT in the reference snapshot — SURVEY.md §2.5 marks
     expert parallelism as new design). Compiled: lax.all_to_all over the
-    'ep' axis; this eager form handles world==1."""
+    'ep' axis; this eager form handles world==1.
+
+    Two calling conventions, mirroring all_gather:
+    - functional (tensor only): stacked [n, ...] -> exchanged, the
+      compiled fast path;
+    - list API (out_tensor_list, in_tensor_list): reference parity.
+      Inside a traced region (shard_map with the axis bound) the n input
+      slices are stacked, exchanged with ONE lax.all_to_all, and
+      unstacked into out_tensor_list — the path chunked MoE dispatch
+      uses, and the one that was missing while all_reduce/all_gather
+      already traced.
+    """
     if in_tensor_list is None:
         # functional: single stacked tensor [n, ...] -> exchanged
         tensor = out_tensor_list
@@ -238,6 +249,20 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
                 name="all_to_all")
         return tensor
     g = get_group(group)
+    name = axis_name or (g.axis_name if g else None)
+    if name is not None and in_tensor_list \
+            and any(_in_trace(t) for t in in_tensor_list):
+        n = len(in_tensor_list)
+
+        def fn(*xs):
+            ex = jax.lax.all_to_all(jnp.stack(xs), name, split_axis=0,
+                                    concat_axis=0)
+            return tuple(ex[i] for i in range(n))
+
+        outs = apply(fn, *in_tensor_list, name="all_to_all")
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        out_tensor_list.extend(outs)
+        return out_tensor_list
     if g.nranks <= 1:
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list

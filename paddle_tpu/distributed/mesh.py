@@ -53,7 +53,52 @@ def axis_size(axis_name: str) -> int:
     return jax.lax.psum(1, axis_name)
 
 
+# ---------------------------------------------------------------------------
+# Version-compat collective helpers (used inside shard_map bodies).
+#
+# spmd/pipeline/moe/ring_attention each used to spell these against
+# jax.lax directly; the names and kwargs moved across jax versions
+# (psum_scatter's `scatter_dimension`, all_gather's `tiled` default), so
+# one shim here keeps every schedule on the same spelling.  All three
+# return the TILED layout: gather concatenates shards on `axis`,
+# reduce_scatter leaves each rank its `axis` slice of the sum.
+# ---------------------------------------------------------------------------
+def all_gather(x, axis_name: str, *, axis: int = 0):
+    """Concatenate every rank's shard along `axis` (tiled all-gather)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter(x, axis_name: str, *, axis: int = 0):
+    """Sum over the axis group and keep this rank's `axis` slice — the
+    transpose of `all_gather`, and the collective ZeRO grads leave the
+    backward as."""
+    if hasattr(jax.lax, "psum_scatter"):
+        return jax.lax.psum_scatter(x, axis_name,
+                                    scatter_dimension=axis, tiled=True)
+    # very old jax: psum + per-rank dynamic slice (correct, not bandwidth
+    # optimal — only a fallback)
+    n = axis_size(axis_name)
+    if x.shape[axis] % n:
+        # psum_scatter would raise here; the fallback must not silently
+        # truncate the trailing rows instead
+        raise ValueError(
+            f"reduce_scatter: dim {axis} of shape {x.shape} is not "
+            f"divisible by axis '{axis_name}' size {n}")
+    full = jax.lax.psum(x, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    shard = x.shape[axis] // n
+    return jax.lax.dynamic_slice_in_dim(full, idx * shard, shard, axis)
+
+
+def ppermute(x, axis_name: str, perm):
+    """Point-to-point send/recv over the axis ring (pipeline stage
+    boundaries). perm: [(src, dst), ...]; unaddressed dsts receive
+    zeros."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "axis_size",
+           "all_gather", "reduce_scatter", "ppermute",
            "create_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
            "default_mesh", "shard_map"]
 

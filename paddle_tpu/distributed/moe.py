@@ -198,7 +198,8 @@ class MoELayer(Layer):
                  aux_loss_coeff: float = 0.01, z_loss_coeff: float = 0.0,
                  normalize_gates: bool = True, ep_axis: str = "ep",
                  weight_attr=None, down_weight_attr=None,
-                 activation: str = "gelu"):
+                 activation: str = "gelu",
+                 a2a_chunks: Optional[int] = None):
         super().__init__()
         self.num_experts = num_experts
         self.top_k = top_k
@@ -207,6 +208,11 @@ class MoELayer(Layer):
         self.z_loss_coeff = z_loss_coeff
         self.normalize_gates = normalize_gates
         self.ep_axis = ep_axis
+        # chunked all-to-all (shard_map path): K > 1 splits dispatch/
+        # combine so chunk j's exchange overlaps chunk j-1's expert FFN;
+        # None resolves per-trace from PADDLE_TPU_MOE_A2A_CHUNKS /
+        # PADDLE_TPU_OVERLAP (distributed.overlap.moe_a2a_chunks)
+        self.a2a_chunks = a2a_chunks
         self.gate = self.create_parameter(
             [hidden_size, num_experts],
             attr=weight_attr, default_initializer=I.Normal(0.0, 0.02))
@@ -260,17 +266,54 @@ class MoELayer(Layer):
         combine = combine.astype(x.dtype)
         xe = jnp.einsum("bsec,bsh->ebch", dispatch, x)   # [E,b,C,H]
         xe = xe.reshape(n_exp, b * cap, h)
-        # dispatch: each device keeps its expert rows of everyone's tokens
-        xe = jax.lax.all_to_all(xe, axis, split_axis=0, concat_axis=1,
-                                tiled=True)              # [E_loc, W*b*C, H]
-        h1 = self.experts.act(
-            jnp.einsum("egh,ehf->egf", xe, w_up.astype(x.dtype))
-            + b_up.astype(x.dtype)[:, None, :])
-        ye = jnp.einsum("egf,efh->egh", h1, w_down.astype(x.dtype)) \
-            + b_down.astype(x.dtype)[:, None, :]
-        # combine: return expert outputs to the token owners
-        ye = jax.lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
-                                tiled=True)              # [E, b*C, H]
+
+        def expert_ffn(xg):
+            """Local experts over a token-slot slice [E_loc, g, H] —
+            pointwise per token, so chunking the slot dim is exact."""
+            h1 = self.experts.act(
+                jnp.einsum("egh,ehf->egf", xg, w_up.astype(x.dtype))
+                + b_up.astype(x.dtype)[:, None, :])
+            return jnp.einsum("egf,efh->egh", h1,
+                              w_down.astype(x.dtype)) \
+                + b_down.astype(x.dtype)[:, None, :]
+
+        # chunked dispatch/combine (GShard-style a2a splitting): chunk
+        # j+1's exchange has no dependence on chunk j's FFN, so the
+        # async-collective scheduler can run them concurrently; K=1 is
+        # the monolithic synchronous exchange.  Identical math either
+        # way — the chunks partition the token-slot dim.
+        if self.a2a_chunks is not None:
+            # an explicit K that doesn't divide would be silently
+            # rewritten — someone A/B-measuring chunk counts must not
+            # get numbers for a different K than they asked for
+            k = int(self.a2a_chunks)
+            if k < 1 or (b * cap) % k:
+                raise ValueError(
+                    f"a2a_chunks={k} must divide the per-device token "
+                    f"slots b*capacity={b * cap} (b={b}, capacity="
+                    f"{cap}); pick a divisor or leave a2a_chunks=None "
+                    f"for the auto-clamped default")
+        else:
+            # env/default resolution clamps to the nearest divisor
+            from .overlap import moe_a2a_chunks as _resolve_chunks
+            k = _resolve_chunks(b * cap)
+        csz = (b * cap) // k
+        ye_chunks = []
+        for j in range(k):
+            xj = jax.lax.slice_in_dim(xe, j * csz, (j + 1) * csz, axis=1)
+            # dispatch: each device keeps its expert rows of everyone's
+            # tokens in this chunk
+            xj = jax.lax.all_to_all(xj, axis, split_axis=0,
+                                    concat_axis=1,
+                                    tiled=True)      # [E_loc, W*csz, H]
+            yj = expert_ffn(xj)
+            # combine: return this chunk's expert outputs to the owners
+            yj = jax.lax.all_to_all(yj, axis, split_axis=1,
+                                    concat_axis=0,
+                                    tiled=True)      # [E, csz, H]
+            ye_chunks.append(yj)
+        ye = ye_chunks[0] if k == 1 else jnp.concatenate(ye_chunks,
+                                                         axis=1)
         ye = ye.reshape(n_exp, b, cap, h)
         y = jnp.einsum("bsec,ebch->bsh", combine, ye)
         return y, aux, zloss
@@ -298,8 +341,18 @@ class MoELayer(Layer):
             arr, NamedSharding(mesh, PartitionSpec(*names)))
 
     def forward(self, x):
-        fn = self._fn_shard_map if _in_shard_map(self.ep_axis) \
-            else self._fn_dense
+        in_sm = _in_shard_map(self.ep_axis)
+        if not in_sm and self.a2a_chunks not in (None, 1):
+            # the GSPMD path's all-to-all is XLA-inserted (no manual
+            # exchange to chunk); silently ignoring an explicit K here
+            # would hand an A/B measurement the monolithic numbers
+            raise NotImplementedError(
+                f"a2a_chunks={self.a2a_chunks} only applies to the "
+                f"shard_map expert-parallel formulation (the '"
+                f"{self.ep_axis}' axis bound inside shard_map); the "
+                f"GSPMD path's all-to-all is inserted by XLA and cannot "
+                f"be chunked from here — leave a2a_chunks=None")
+        fn = self._fn_shard_map if in_sm else self._fn_dense
         y, aux, zloss = apply(
             fn, x, self.gate, self.experts.w_up, self.experts.b_up,
             self.experts.w_down, self.experts.b_down, name="moe_layer")

@@ -1,0 +1,161 @@
+"""Latency-hiding collectives — the one knob and its defaults.
+
+``PADDLE_TPU_OVERLAP`` governs every communication-overlap schedule in
+the framework (default ON; set ``0`` to force every schedule back to its
+synchronous counterpart for A/B runs):
+
+- ZeRO-3 overlapped parameter all-gather (`distributed.zero3`, wired by
+  SpmdTrainer when ``sharding_configs={'stage': 3}`` + scan-over-layers);
+- the 1F1B pipeline schedule default (`distributed.pipeline`,
+  ``schedule=None`` resolves here);
+- chunked MoE all-to-all (`distributed.moe`, ``a2a_chunks=None``
+  resolves here);
+- the XLA async-collective / latency-hiding-scheduler flags on real
+  accelerator backends (`ensure_xla_overlap_flags`).
+
+All of the schedules are numerics-preserving (they reorder communication,
+not math); the dryrun and tests assert loss parity against the
+synchronous paths, so the default can be ON.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["overlap_enabled", "pipeline_schedule_default",
+           "moe_a2a_chunks", "ensure_xla_overlap_flags"]
+
+
+def overlap_enabled() -> bool:
+    """The master knob: PADDLE_TPU_OVERLAP (default on)."""
+    return os.environ.get("PADDLE_TPU_OVERLAP", "1") != "0"
+
+
+def pipeline_schedule_default() -> str:
+    """Schedule used when GPipeTrainer(schedule=None):
+    PADDLE_TPU_PIPELINE_SCHEDULE if set, else 'gpipe'.  1F1B is chosen
+    per-constructor (schedule='1f1b') or via the env var — it computes
+    the same losses but its explicit interleaved backward is a different
+    compiled program, so flipping an existing trainer's schedule is an
+    intentional act, not an ambient default.
+
+    PADDLE_TPU_OVERLAP=0 overrides the env-var schedule back to 'gpipe'
+    (the documented 'every schedule falls back to its synchronous
+    counterpart' contract — an A/B flip of the one knob must actually
+    change the program); an explicit constructor argument still wins
+    over both."""
+    if not overlap_enabled():
+        return "gpipe"
+    return os.environ.get("PADDLE_TPU_PIPELINE_SCHEDULE") or "gpipe"
+
+
+def moe_a2a_chunks(tokens: int) -> int:
+    """Chunk count for the MoE shard_map all-to-all when the layer was
+    built with ``a2a_chunks=None``: PADDLE_TPU_MOE_A2A_CHUNKS if set,
+    else 2 (so chunk j's exchange can overlap chunk j-1's expert FFN).
+    PADDLE_TPU_OVERLAP=0 forces 1 (monolithic) EVEN IF the chunk env
+    var is set — the kill switch must win over every env-selected
+    schedule or an A/B of the one knob measures nothing (only an
+    explicit MoELayer(a2a_chunks=...) argument overrides it).  Always
+    clamped to a divisor of `tokens` (the per-expert token-slot count)
+    — a ragged chunk would change shapes, and shape stability is the
+    recompile-free contract."""
+    if not overlap_enabled():
+        return 1
+    want = int(os.environ.get("PADDLE_TPU_MOE_A2A_CHUNKS", "0")) or 2
+    want = max(1, min(want, tokens if tokens > 0 else 1))
+    while tokens % want:
+        want -= 1
+    return want
+
+
+# XLA flags that let the compiler's latency-hiding scheduler run
+# collectives asynchronously behind compute.  Only meaningful (and only
+# RECOGNIZED) on real accelerator backends — the CPU backend rejects
+# unknown flags at startup, so these are gated on the declared platform.
+_TPU_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+)
+_GPU_FLAGS = (
+    "--xla_gpu_enable_latency_hiding_scheduler=true",
+    "--xla_gpu_enable_highest_priority_async_stream=true",
+)
+
+
+def _detect_platform() -> str:
+    """Best-effort accelerator probe for when JAX_PLATFORMS is unset
+    (jax auto-detect — the normal pod deployment): libtpu / TPU runtime
+    env means 'tpu', visible CUDA devices mean 'gpu', else unknown."""
+    import importlib.util
+    try:
+        if importlib.util.find_spec("libtpu") is not None:
+            return "tpu"
+    except (ImportError, ValueError):
+        pass
+    if any(os.environ.get(k) for k in
+           ("TPU_WORKER_ID", "TPU_CHIPS_PER_HOST_BOUNDS",
+            "PALLAS_AXON_POOL_IPS")):
+        return "tpu"
+    cuda = os.environ.get("CUDA_VISIBLE_DEVICES")
+    if cuda not in (None, "", "-1"):
+        return "gpu"
+    # the common GPU deployment leaves CUDA_VISIBLE_DEVICES unset and
+    # lets the jax plugin auto-detect — probe for the plugin/driver
+    for mod in ("jax_cuda12_plugin", "jax_cuda11_plugin"):
+        try:
+            if importlib.util.find_spec(mod) is not None:
+                return "gpu"
+        except (ImportError, ValueError):
+            pass
+    if os.path.exists("/dev/nvidia0"):
+        return "gpu"
+    return ""
+
+
+def ensure_xla_overlap_flags(platform: str = None, env: dict = None) -> bool:
+    """Append the async-collective / latency-hiding-scheduler XLA flags
+    to XLA_FLAGS when the overlap knob is on and the target platform is
+    an accelerator.  Must take effect BEFORE a jax backend initializes
+    (env flags are read once); returns True when the flags are (already)
+    active, False when it was too late or the platform is host-only.
+
+    platform defaults to the declared JAX_PLATFORMS (the dryrun/test
+    environments pin 'cpu' there, which correctly skips these flags).
+    env defaults to os.environ; pass a CHILD process's env dict (the
+    launcher does) to arm a worker that has not started yet — the
+    in-process too-late guard does not apply there."""
+    if not overlap_enabled():
+        return False
+    # NB: when arming a child env dict, only ITS JAX_PLATFORMS counts —
+    # _trainer_env builds children from a copy of os.environ, so a
+    # parent setting is already there if it applies
+    target = os.environ if env is None else env
+    plat = (platform or target.get("JAX_PLATFORMS", "")).lower()
+    if not plat:
+        # JAX_PLATFORMS unset is the COMMON accelerator deployment (jax
+        # auto-detects); probe the environment the way jax will
+        plat = _detect_platform()
+    if plat.startswith("cpu") or not plat:
+        # unknown/host platform: adding accelerator-only flags would
+        # abort backend startup
+        return False
+    flags = _TPU_FLAGS if "tpu" in plat else _GPU_FLAGS
+    current = target.get("XLA_FLAGS", "")
+    # exact flag-NAME comparison: substring matching would treat
+    # `--xla_..._fusion` as present when only the longer
+    # `--xla_..._fusion_fuse_all_gather` is set
+    current_names = {f.split("=")[0] for f in current.split()}
+    missing = [f for f in flags if f.split("=")[0] not in current_names]
+    if not missing:
+        return True
+    if env is None and "jaxlib" in sys.modules:
+        # backend plausibly initialized already: XLA_FLAGS edits would be
+        # silently ignored — report honestly instead of pretending
+        return False
+    target["XLA_FLAGS"] = (current + " " + " ".join(missing)).strip()
+    return True

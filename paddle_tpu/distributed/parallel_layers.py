@@ -77,8 +77,8 @@ class ColumnParallelLinear(Layer):
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output and _in_shard_map(self.axis_name):
             name = self.axis_name
-            y = apply(lambda a: jax.lax.all_gather(a, name, axis=a.ndim - 1,
-                                                   tiled=True),
+            from . import mesh as _mesh
+            y = apply(lambda a: _mesh.all_gather(a, name, axis=a.ndim - 1),
                       y, name="c_allgather")
         return y
 

@@ -1,4 +1,4 @@
-"""Pipeline parallelism — GPipe as ONE compiled SPMD program.
+"""Pipeline parallelism — GPipe and 1F1B as ONE compiled SPMD program.
 
 Reference mapping: the reference implements pipelining with a C++
 scheduler (SectionWorker::TrainFiles, /root/reference/paddle/fluid/
@@ -18,15 +18,32 @@ a `lax.scan` over pipeline ticks inside one jitted step under
   per-device program sections;
 - at every tick each rank runs its slab (an inner `lax.scan` over its
   layers, optionally remat'ed) and hands its activation to the next rank
-  with `lax.ppermute` — the send_v2/recv_v2 pair, but compiled into the
+  with `ppermute` — the send_v2/recv_v2 pair, but compiled into the
   program so XLA overlaps compute with the ICI transfer;
-- rank 0 injects a fresh microbatch each tick, the last rank banks its
-  finished microbatch; after M + S - 1 ticks all M microbatches are done
-  (GPipe F-then-B: jax.grad transposes the scan, which replays the
-  ticks in reverse — exactly the reference's all-Forward-then-all-
-  Backward order, with send/recv transposed automatically);
 - embedding ("pre") and head ("post") parameters are replicated across
   'pp'; their gradients are psum'd over the mesh.
+
+Two schedules share that machinery (``schedule=`` ctor arg):
+
+- ``"gpipe"``: rank 0 injects a fresh microbatch each tick, the last
+  rank banks its finished microbatch; after M + S - 1 ticks all M are
+  done, and `jax.grad` transposes the scan — all-Forward-then-all-
+  Backward, with activations for every in-flight microbatch live at
+  once (peak activation memory O(M));
+- ``"1f1b"``: the one-forward-one-backward steady state of Megatron-LM
+  (Narayanan et al. 2021, non-interleaved PipeDream-flush).  Each tick
+  runs one forward AND one explicitly-written backward: the backward
+  wavefront trails the forward by the warmup depth (pp - 1
+  microbatches), so a microbatch's gradients start flowing as soon as
+  the last stage finishes it instead of after the full fill.  Each rank
+  stashes only the stage INPUTS of its in-flight microbatches — at most
+  ``min(2*pp - 1, M)`` slots, O(pp) not O(M) — and re-computes the
+  stage forward inside `jax.vjp` at the backward tick (activation
+  recompute, the standard 1F1B memory/compute trade).  Forward
+  activations and backward grad-activations cross stage boundaries with
+  two ppermutes per tick whose transfers are independent of the
+  adjacent microbatch's compute, exactly the islands the async
+  collective scheduler (PADDLE_TPU_OVERLAP) hides.
 
 Data parallelism composes: with a ('dp', 'pp') mesh the microbatch dim
 is additionally sharded over 'dp' and gradients are psum'd over 'dp'
@@ -35,6 +52,8 @@ inside the same program.
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -45,6 +64,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
+from . import mesh as _mesh
 from .fleet.strategy import DistributedStrategy
 from .mesh import Mesh, NamedSharding, PartitionSpec, shard_map
 
@@ -104,9 +124,17 @@ class GPipeTrainer:
                  num_microbatches: int = 2, pp_axis: str = "pp",
                  dp_axis: str = "dp", remat: bool = True,
                  strategy: Optional[DistributedStrategy] = None,
-                 dedupe_head: bool = True, buffer_mode: str = "forbid"):
+                 dedupe_head: bool = True, buffer_mode: str = "forbid",
+                 schedule: Optional[str] = None,
+                 comm_stats: Optional[bool] = None):
         if pp_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no '{pp_axis}' axis")
+        from .overlap import pipeline_schedule_default
+        self.schedule = schedule or pipeline_schedule_default()
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got "
+                f"{self.schedule!r}")
         if buffer_mode not in ("forbid", "frozen"):
             raise ValueError(
                 f"buffer_mode must be 'forbid' or 'frozen', got "
@@ -150,6 +178,14 @@ class GPipeTrainer:
         self.dedupe_head = (dedupe_head and
                             num_microbatches % mesh.shape[pp_axis] == 0)
         self.num_layers = len(blocks)
+        # step-time + collective breakdown (mirrors SpmdTrainer.stats);
+        # comm analysis AOT-compiles the step a second time → opt-in
+        self._comm_enabled = bool(
+            comm_stats if comm_stats is not None
+            else os.environ.get("PADDLE_TPU_COMM_STATS") == "1")
+        self._comm: Optional[dict] = None
+        self._timings = {"dispatch_ms": 0.0, "compile_ms_cold": 0.0,
+                         "steps_timed": 0}
         if self.num_layers % self.pp_size:
             raise ValueError(
                 f"{self.num_layers} blocks not divisible by pp degree "
@@ -215,6 +251,37 @@ class GPipeTrainer:
         self._compiled = None
 
     # ------------------------------------------------------------------
+    def _slice_frozen_buffers(self, idx):
+        """(buf_slab, pre_buf, post_buf) for this rank when
+        buffer_mode='frozen' (block buffers stacked [L, ...]; each rank
+        slices its layer slab), else (None, None, None).  Shared by both
+        schedules so the slicing convention cannot diverge."""
+        fb = self._frozen_buffers
+        if fb is None:
+            return None, None, None
+        lps = self.num_layers // self.pp_size
+        buf_slab = {k: jax.lax.dynamic_slice_in_dim(v, idx * lps, lps, 0)
+                    for k, v in fb["blocks"].items()} or None
+        return buf_slab, fb["pre"], fb["post"]
+
+    def _head_loss_raw(self, post_p, h, lab_idx, micro_lab, post_buf,
+                       training=True):
+        """post + user loss for ONE microbatch activation -> f32 scalar
+        (un-scaled; router aux NOT included — callers own their
+        collector scope and their 1/M conventions).  The single source
+        of head/label plumbing for both schedules."""
+        out = _call(self.post, post_p, Tensor(h), training=training,
+                    buffers=post_buf)
+        out_t = jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out)
+        lab = jax.tree_util.tree_map(
+            lambda a: Tensor(jax.lax.dynamic_index_in_dim(
+                a, lab_idx, 0, keepdims=False)), micro_lab)
+        lab = lab if isinstance(lab, (list, tuple)) else (lab,)
+        l = self.loss_fn(out_t, *lab)
+        return (l.data if isinstance(l, Tensor) else l) \
+            .astype(jnp.float32)
+
     def _stage_fn(self, slab, h, training, buf_slab=None):
         """Run this rank's slab of layers: inner scan over [L/S, ...].
         Returns (h, aux): aux losses (MoE routers) produced inside the
@@ -245,15 +312,7 @@ class GPipeTrainer:
         idx = jax.lax.axis_index(self.pp_axis)
         pre_p, slab, post_p = (params["pre"], params["blocks"],
                                params["post"])
-        fb = self._frozen_buffers
-        if fb is not None:
-            lps = self.num_layers // S
-            buf_slab = {k: jax.lax.dynamic_slice_in_dim(v, idx * lps,
-                                                        lps, 0)
-                        for k, v in fb["blocks"].items()} or None
-            pre_buf, post_buf = fb["pre"], fb["post"]
-        else:
-            buf_slab = pre_buf = post_buf = None
+        buf_slab, pre_buf, post_buf = self._slice_frozen_buffers(idx)
 
         def pre_fn(i):
             x = jax.lax.dynamic_index_in_dim(micro_in, i, 0,
@@ -304,17 +363,8 @@ class GPipeTrainer:
 
         def head_loss(h, lab_idx):
             """post + loss for one microbatch activation h."""
-            out = _call(self.post, post_p, Tensor(h), training=training,
-                        buffers=post_buf)
-            out_t = jax.tree_util.tree_map(
-                lambda a: Tensor(a, stop_gradient=True), out)
-            lab = jax.tree_util.tree_map(
-                lambda a: Tensor(jax.lax.dynamic_index_in_dim(
-                    a, lab_idx, 0, keepdims=False)), micro_lab)
-            lab = lab if isinstance(lab, (list, tuple)) else (lab,)
-            l = self.loss_fn(out_t, *lab)
-            return (l.data if isinstance(l, Tensor) else l) \
-                .astype(jnp.float32)
+            return self._head_loss_raw(post_p, h, lab_idx, micro_lab,
+                                       post_buf, training=training)
 
         if self.dedupe_head and S > 1:
             # head+loss SHARDED over pp: broadcast the finished
@@ -343,7 +393,233 @@ class GPipeTrainer:
         # block aux: each rank saw every microbatch once -> mean over M
         return (local + aux_acc / M) / self.dp_size
 
+    # ------------------------------------------------------------------
+    # 1F1B (PipeDream-flush / Megatron non-interleaved) schedule
+    # ------------------------------------------------------------------
+    def stash_slots(self) -> int:
+        """Per-rank stage-input stash size of the 1F1B schedule: the
+        deepest rank keeps 2*(pp-1) microbatch inputs in flight plus the
+        one being produced, capped by M.  GPipe's equivalent figure (see
+        peak_activation_slots) is M — the whole point of 1F1B."""
+        return min(2 * self.pp_size - 1, self.num_micro)
+
+    def peak_activation_slots(self) -> int:
+        """Structural peak-activation figure for memory assertions:
+        microbatch-sized activation buffers the schedule keeps live per
+        rank (1f1b: the input stash; gpipe: the banked-output buffer —
+        the scan-transpose residuals it ALSO keeps make this a lower
+        bound for gpipe, so the comparison is conservative)."""
+        return self.stash_slots() if self.schedule == "1f1b" \
+            else self.num_micro
+
+    def _pipeline_1f1b_local(self, params, micro_in, micro_lab):
+        """Per-rank 1F1B program (inside shard_map): explicit forward
+        AND backward wavefronts in one tick scan — no jax.grad over the
+        schedule.  Returns (local_loss, grads) with the same scaling
+        conventions as the GPipe path, so the caller's psums are
+        identical.
+
+        Clocks (S = pp, M = microbatches, rank = idx):
+          forward of microbatch m at tick  m + idx
+          backward of microbatch m at tick m + 2*(S-1) - idx
+        so the last rank backwards a microbatch the tick its forward
+        finishes, and the backward activation-grad reaches rank idx-1
+        exactly one tick later (one reverse ppermute per tick).  Total
+        ticks: M + 2*(S-1).  Each rank stashes only its stage INPUT per
+        in-flight microbatch (stash_slots() of them) and re-runs the
+        stage forward inside jax.vjp at the backward tick (activation
+        recompute), which is what shrinks peak activation memory from
+        GPipe's O(M) to O(pp)."""
+        from .moe import collect_aux_losses
+        S, M = self.pp_size, self.num_micro
+        Q = self.stash_slots()
+        T = M + 2 * (S - 1)
+        pp, dp_div = self.pp_axis, float(self.dp_size)
+        idx = jax.lax.axis_index(pp)
+        pre_p, slab, post_p = (params["pre"], params["blocks"],
+                               params["post"])
+        buf_slab, pre_buf, post_buf = self._slice_frozen_buffers(idx)
+
+        def pre_fn(pp_params, i):
+            x = jax.lax.dynamic_index_in_dim(micro_in, i, 0,
+                                             keepdims=False)
+            return _call(self.pre, pp_params, Tensor(x), training=True,
+                         buffers=pre_buf)
+
+        # embed ALL microbatches once (same trade as GPipe: per-tick pre
+        # would run T times per rank); these are model INPUTS, not stage
+        # activations — the 1F1B memory claim is about the stash below
+        pre_emb = jnp.stack([pre_fn(pre_p, m) for m in range(M)])
+
+        def head_scalar(post_params, h, lab_idx):
+            """post + loss for one microbatch, scaled 1/M (incl. its
+            router aux) — the unit the backward wavefront seeds."""
+            with collect_aux_losses() as post_aux:
+                l = self._head_loss_raw(post_params, h, lab_idx,
+                                        micro_lab, post_buf)
+            for a in post_aux:
+                l = l + (a.data if isinstance(a, Tensor)
+                         else a).astype(jnp.float32)
+            return l / M
+
+        def stage_for_vjp(sl, xx):
+            return self._stage_fn(sl, xx, True, buf_slab)
+
+        h_shape = pre_emb.shape[1:]
+        h_dtype = pre_emb.dtype
+        zero_h = jnp.zeros(h_shape, h_dtype)
+        zeros_like_tree = lambda t: jax.tree_util.tree_map(
+            jnp.zeros_like, t)
+        # grad deltas come out of lax.cond branches whose false side is
+        # exact zeros — a plain add accumulates them, no re-masking
+        tree_add = lambda acc, d: jax.tree_util.tree_map(jnp.add, acc, d)
+
+        def tick(carry, t):
+            (act, gy, stash, dslab, dpre, dpost, loss_acc,
+             aux_acc) = carry
+            # bubble ticks and non-owning ranks skip their halves at
+            # RUNTIME via lax.cond (per-device control flow is legal
+            # under shard_map, and nothing here is differentiated from
+            # outside — the backward is already explicit), instead of
+            # computing garbage and masking it: at pp=4/M=8 the masked
+            # formulation ran 13 head+embedding vjps per rank where 8
+            # (resp. 8 on rank 0 only) are real.
+            # ---- forward half: one microbatch through my slab --------
+            valid_f = (t >= idx) & (t < idx + M)
+            y, aux_t = jax.lax.cond(
+                valid_f,
+                lambda a: self._stage_fn(slab, a, True, buf_slab),
+                lambda a: (jnp.zeros_like(a), jnp.float32(0.0)), act)
+            aux_acc = aux_acc + aux_t
+            mf = jnp.clip(t - idx, 0, M - 1)
+            slot_f = jnp.mod(mf, Q)
+            kept = jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
+                                                keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, act, kept), slot_f, 0)
+            # ---- backward half: the trailing wavefront ---------------
+            mb = t - 2 * (S - 1) + idx
+            valid_b = (mb >= 0) & (mb < M)
+            mbc = jnp.clip(mb, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(mbc, Q), 0, keepdims=False)
+            is_last = idx == S - 1
+            # last rank: this tick's y IS microbatch mb's finished stage
+            # output (the clocks coincide there) — seed the backward
+            # with the loss gradient and bank the loss value
+            take_head = valid_b & is_last
+
+            def head_branch(y_):
+                lm, head_vjp = jax.vjp(
+                    lambda hp, hh: head_scalar(hp, hh, mbc), post_p, y_)
+                dpost_t, dy = head_vjp(jnp.asarray(1.0 / dp_div,
+                                                   jnp.float32))
+                return lm, dpost_t, dy
+
+            lm, dpost_t, dy = jax.lax.cond(
+                take_head, head_branch,
+                lambda y_: (jnp.float32(0.0), zeros_like_tree(post_p),
+                            jnp.zeros_like(y_)), y)
+            loss_acc = loss_acc + lm / dp_div
+            dpost = tree_add(dpost, dpost_t)
+            gy_eff = jnp.where(is_last, dy.astype(h_dtype), gy)
+            # stage backward by recompute: vjp wrt (slab, stage input);
+            # the aux cotangent routes the router losses' grads
+
+            def bwd_branch(op):
+                gy_, x_ = op
+                _, stage_vjp = jax.vjp(stage_for_vjp, slab, x_)
+                return stage_vjp(
+                    (gy_, jnp.float32(1.0 / (M * dp_div))))
+
+            dslab_t, dx = jax.lax.cond(
+                valid_b, bwd_branch,
+                lambda op: (zeros_like_tree(slab),
+                            jnp.zeros_like(op[1])), (gy_eff, x_saved))
+            dslab = tree_add(dslab, dslab_t)
+            # rank 0 owns the embedding backward for its microbatch
+            take_pre = valid_b & (idx == 0)
+
+            def pre_branch(dx_):
+                _, pre_vjp = jax.vjp(lambda hp: pre_fn(hp, mbc), pre_p)
+                (dpre_t,) = pre_vjp(dx_)
+                return dpre_t
+
+            dpre_t = jax.lax.cond(
+                take_pre, pre_branch,
+                lambda dx_: zeros_like_tree(pre_p), dx)
+            dpre = tree_add(dpre, dpre_t)
+            # ---- stage-boundary traffic for the next tick ------------
+            if S > 1:
+                y_next = _mesh.ppermute(
+                    y, pp, [(i, i + 1) for i in range(S - 1)])
+                gy_next = _mesh.ppermute(
+                    dx, pp, [(i, i - 1) for i in range(1, S)])
+            else:
+                y_next, gy_next = y, dx
+            inj = jax.lax.dynamic_index_in_dim(
+                pre_emb, jnp.clip(t + 1, 0, M - 1), 0, keepdims=False)
+            act = jnp.where(idx == 0, inj, y_next)
+            return (act, gy_next, stash, dslab, dpre, dpost, loss_acc,
+                    aux_acc), None
+
+        init = (jnp.where(idx == 0, pre_emb[0], zero_h),
+                zero_h,
+                jnp.zeros((Q,) + h_shape, h_dtype),
+                zeros_like_tree(slab), zeros_like_tree(pre_p),
+                zeros_like_tree(post_p),
+                jnp.float32(0.0), jnp.float32(0.0))
+        (act, gy, stash, dslab, dpre, dpost, loss_acc, aux_acc), _ = \
+            jax.lax.scan(tick, init, jnp.arange(T))
+        local = loss_acc + aux_acc / (M * dp_div)
+        return local, {"pre": dpre, "blocks": dslab, "post": dpost}
+
+    def _build_1f1b(self):
+        mesh = self.mesh
+        P = PartitionSpec
+        pp, dp = self.pp_axis, self.dp_axis
+        has_dp = self.dp_size > 1
+        in_specs_params = {
+            "pre": self._specs["pre"], "blocks": self._specs["blocks"],
+            "post": self._specs["post"]}
+        batch_spec = P(None, dp) if has_dp else P()
+
+        def local_step(params, micro_in, micro_lab):
+            local, grads = self._pipeline_1f1b_local(
+                params, micro_in, micro_lab)
+            axes_repl = (pp, dp) if has_dp else (pp,)
+            grads = {
+                "pre": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axes_repl), grads["pre"]),
+                "blocks": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, dp) if has_dp else g,
+                    grads["blocks"]),
+                "post": jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axes_repl), grads["post"]),
+            }
+            loss = jax.lax.psum(local, axes_repl)
+            return loss, grads
+
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(in_specs_params, batch_spec, batch_spec),
+            out_specs=(P(), dict(in_specs_params)),
+            check_vma=False)
+
+        def step(params, opt_state, lr, step_no, micro_in, micro_lab):
+            loss, grads = smapped(params, micro_in, micro_lab)
+            new_params, new_opt = self.optimizer.apply_gradients(
+                params, grads, opt_state, lr=lr, step=step_no)
+            return new_params, new_opt, loss
+
+        return jax.jit(
+            step,
+            out_shardings=(self._param_shardings, None, None),
+            donate_argnums=(0, 1))
+
     def _build(self, training=True):
+        if self.schedule == "1f1b" and training:
+            return self._build_1f1b()
         mesh = self.mesh
         P = PartitionSpec
         pp, dp = self.pp_axis, self.dp_axis
@@ -399,8 +675,15 @@ class GPipeTrainer:
         a = arr.data if isinstance(arr, Tensor) else jnp.asarray(arr)
         b = a.shape[0]
         if b % self.num_micro:
-            raise ValueError(f"batch {b} not divisible by "
-                             f"{self.num_micro} microbatches")
+            # a silent truncation here would drop samples from every
+            # step — refuse loudly instead (drop the remainder yourself
+            # or pick a num_microbatches that divides the batch)
+            raise ValueError(
+                f"batch size {b} is not divisible by num_microbatches="
+                f"{self.num_micro}: the pipeline schedule needs equal "
+                f"microbatches. Pad or trim the batch to a multiple of "
+                f"{self.num_micro}, or construct the trainer with a "
+                f"num_microbatches that divides {b}.")
         mb = a.reshape((self.num_micro, b // self.num_micro) + a.shape[1:])
         spec = PartitionSpec(
             None, self.dp_axis if (self.dp_size > 1 and
@@ -413,15 +696,54 @@ class GPipeTrainer:
         micro_lab = jax.tree_util.tree_map(
             self._microbatch, labels,
             is_leaf=lambda x: isinstance(x, Tensor))
-        if self._compiled is None:
-            self._compiled = self._build(training=True)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self._step_count + 1, jnp.int32)
+        first = self._compiled is None
+        if first:
+            self._compiled = self._build(training=True)
+            if self._comm_enabled:
+                # AOT collective breakdown while the args are alive (the
+                # real call donates params/opt_state)
+                from ..utils import comm_stats as _cs
+                self._comm = _cs.analyze_jit(
+                    self._compiled, self.params, self.opt_state, lr,
+                    step_no, micro_in, micro_lab,
+                    device=self.mesh.devices.flat[0])
+        t0 = time.perf_counter()
         self.params, self.opt_state, loss = self._compiled(
             self.params, self.opt_state, lr, step_no, micro_in, micro_lab)
+        dt = (time.perf_counter() - t0) * 1e3
+        if first:
+            self._timings["compile_ms_cold"] += dt
+        else:
+            self._timings["dispatch_ms"] += dt
+            self._timings["steps_timed"] += 1
         self._step_count += 1
         self.optimizer._step_count = self._step_count
         return loss
+
+    @property
+    def stats(self) -> dict:
+        """Schedule + step-time + collective breakdown (the pipeline
+        mirror of SpmdTrainer.stats; comm fields need comm_stats=True /
+        PADDLE_TPU_COMM_STATS=1)."""
+        s = {"schedule": self.schedule,
+             "num_microbatches": self.num_micro,
+             "pp_size": self.pp_size,
+             "peak_activation_slots": self.peak_activation_slots()}
+        for k, v in self._timings.items():
+            s[k] = round(v, 3) if isinstance(v, float) else v
+        res = self._comm
+        s["comm_ms"] = res["comm_ms"] if res else None
+        s["comm_bytes"] = res["bytes"] if res else None
+        s["comm_collectives"] = res["count"] if res else None
+        s["comm_by_op"] = res["by_op"] if res else None
+        steps = self._timings["steps_timed"]
+        mean_step = (self._timings["dispatch_ms"] / steps) if steps \
+            else 0.0
+        s["comm_fraction"] = round(res["comm_ms"] / mean_step, 4) \
+            if (res and mean_step > 0) else None
+        return s
 
     # ------------------------------------------------------------------
     def save(self, path: str, extra=None) -> str:

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from ..core.autograd import apply
 from ..core.tensor import Tensor
+from . import mesh as _mesh
 from .mesh import Mesh, PartitionSpec, get_mesh, shard_map
 from .mesh import axis_size as _axis_size
 
@@ -98,8 +99,8 @@ def ring_attention_local(q, k, v, axis_name: str = "sp",
         # compute reads k_cur, the permute also reads k_cur: XLA overlaps
         # the neighbor exchange with this round's matmuls
         o, m, l = block(o, m, l, k_cur, v_cur, i)
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_cur = _mesh.ppermute(k_cur, axis_name, perm)
+        v_cur = _mesh.ppermute(v_cur, axis_name, perm)
         return (o, m, l, k_cur, v_cur), None
 
     o0 = jnp.zeros((b, hkv, g, t, d), jnp.float32)

@@ -115,7 +115,8 @@ class SpmdTrainer:
                  strategy: Optional[DistributedStrategy] = None,
                  dp_axis: str = "dp", sp_axis: Optional[str] = None,
                  donate: bool = True,
-                 anomaly_policy: Optional[str] = None):
+                 anomaly_policy: Optional[str] = None,
+                 comm_stats: Optional[bool] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -148,6 +149,15 @@ class SpmdTrainer:
         import threading
         self._timings_lock = threading.Lock()
         self._first_call_keys: set = set()
+
+        # collective breakdown (comm_ms/comm_fraction in trainer.stats):
+        # opt-in — measuring it AOT-compiles each step executable a
+        # second time, which the tight test/CI budgets cannot afford by
+        # default (bench/dryrun turn it on)
+        self._comm_enabled = bool(
+            comm_stats if comm_stats is not None
+            else os.environ.get("PADDLE_TPU_COMM_STATS") == "1")
+        self._comm: Dict[Any, dict] = {}
 
         st = self.strategy
         if st.pipeline:
@@ -289,6 +299,22 @@ class SpmdTrainer:
                     "has no enable_scan_layers(); only models with a "
                     "homogeneous block stack (GPT) support scanning")
             model.enable_scan_layers(True)
+
+        # ZeRO-3 overlapped all-gather (distributed.zero3): with stage-3
+        # sharded params AND a scanned layer stack, the scan prefetches
+        # layer i+1's params (explicit all-gather under shard_map) while
+        # layer i computes, and grads come back reduce-scattered over dp.
+        # sharding_configs={'overlap': False} (or PADDLE_TPU_OVERLAP=0)
+        # keeps the synchronous GSPMD stage-3 placement for A/B.
+        from .overlap import overlap_enabled
+        _ovl = st.sharding_configs.get("overlap") if st.sharding else None
+        self.zero3_overlap = bool(
+            self.zero_stage >= 3
+            and (_ovl if _ovl is not None else overlap_enabled())
+            and st.recompute_configs.get("scan_layers")
+            and hasattr(model, "enable_zero3_overlap"))
+        if self.zero3_overlap:
+            model.enable_zero3_overlap(dp_axis)
 
         # ---- state pytrees (raw arrays keyed by structured name) --------
         self._param_objs = dict(model.named_parameters())
@@ -453,6 +479,16 @@ class SpmdTrainer:
             self._timings["h2d_ms"] += dt
         return out
 
+    def _analyze_comm(self, key, args):
+        """Collective breakdown of this key's executable (opt-in; one
+        AOT lower+compile per executable, done on the FIRST call while
+        the args are still alive — the real call may donate them)."""
+        from ..utils import comm_stats as _cs
+        res = _cs.analyze_jit(self._compiled[key], *args,
+                              device=self.mesh.devices.flat[0])
+        if res is not None:
+            self._comm[key] = res
+
     def _timed_call(self, key, *args, count_step=True):
         """Invoke a compiled executable, splitting wall time into the
         first call (compile/deserialize) vs steady-state dispatch.
@@ -460,6 +496,8 @@ class SpmdTrainer:
         advancing steps_timed (the gradient-merge 'update' executable:
         its cost amortizes over the window, so dispatch_ms/steps_timed
         stays a truthful per-train_step figure)."""
+        if self._comm_enabled and key not in self._first_call_keys:
+            self._analyze_comm(key, args)
         t0 = time.perf_counter()
         res = self._compiled[key](*args)
         dt = (time.perf_counter() - t0) * 1e3
@@ -1137,6 +1175,43 @@ class SpmdTrainer:
         self._timings["sync_ms"] += (time.perf_counter() - t_sync) * 1e3
         for k, v in self._timings.items():
             s[k] = round(v, 3) if isinstance(v, float) else v
+
+        # collective breakdown (PADDLE_TPU_COMM_STATS / comm_stats=True):
+        # per-step bytes each compiled step moves over the interconnect
+        # and the bandwidth-model transfer time; comm_fraction divides
+        # that by the MEASURED mean step time, so an overlap schedule
+        # that actually hides its collectives shows the fraction shrink
+        # instead of the step time growing
+        comm_ms = comm_bytes = comm_count = 0.0
+        by_op: Dict[str, dict] = {}
+        # one per-step executable counts (the most recently analyzed
+        # fused/accum variant — 'fused' and 'fused_out' are the SAME
+        # step, summing both would double the figure); the gradient-
+        # merge 'update' amortizes over its window
+        step_keys = [k for k in self._comm
+                     if k == "update" or k[0] in ("fused", "fused_out",
+                                                  "accum")]
+        per_step = [k for k in step_keys if k != "update"]
+        chosen = ([per_step[-1]] if per_step else []) + \
+            (["update"] if "update" in self._comm else [])
+        for key in chosen:
+            res = self._comm[key]
+            scale = 1.0 / self.k_steps if key == "update" else 1.0
+            comm_ms += res["comm_ms"] * scale
+            comm_bytes += res["bytes"] * scale
+            comm_count += res["count"] * scale
+            for op, v in res["by_op"].items():
+                slot = by_op.setdefault(op, {"count": 0.0, "bytes": 0.0})
+                slot["count"] += v["count"] * scale
+                slot["bytes"] += v["bytes"] * scale
+        s["comm_ms"] = round(comm_ms, 4) if self._comm else None
+        s["comm_bytes"] = int(comm_bytes) if self._comm else None
+        s["comm_collectives"] = int(comm_count) if self._comm else None
+        s["comm_by_op"] = by_op if self._comm else None
+        steps = self._timings["steps_timed"]
+        mean_step = (self._timings["dispatch_ms"] / steps) if steps else 0.0
+        s["comm_fraction"] = round(comm_ms / mean_step, 4) \
+            if (self._comm and mean_step > 0) else None
         return s
 
     @property

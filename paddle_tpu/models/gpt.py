@@ -536,6 +536,7 @@ class GPTModel(Layer):
                               epsilon=config.layer_norm_epsilon)
         self._recompute = False
         self._scan_layers = False
+        self._zero3_axis = None
 
     def enable_recompute(self, policy=None):
         """strategy.recompute hook: remat every block. Applied in
@@ -563,6 +564,38 @@ class GPTModel(Layer):
         self._scan_layers = bool(flag)
         return self
 
+    def enable_zero3_overlap(self, axis: str = "dp"):
+        """ZeRO-3 latency-hiding hook (SpmdTrainer sharding stage 3 +
+        scan_layers): the layer scan runs under shard_map over `axis`
+        with layer i+1's params all-gathered while layer i computes, and
+        block grads leave the backward reduce-scattered (see
+        distributed.zero3).  Per-trace preconditions (a dp>1 compile
+        mesh, dp-divisible batch, no tensor-parallel specs on block
+        params) are re-checked at trace time; when they fail the plain
+        scan runs and GSPMD places the stage-3 gathers itself."""
+        self._zero3_axis = axis
+        return self
+
+    def _zero3_mesh(self, x):
+        """The compile mesh when the overlapped ZeRO-3 scan can run for
+        this trace, else None."""
+        if self._zero3_axis is None:
+            return None
+        from ..distributed.mesh import get_compile_mesh
+        from ..distributed.zero3 import zero3_scan_available
+        mesh = get_compile_mesh()
+        arr = x.data if isinstance(x, Tensor) else x
+        if not zero3_scan_available(mesh, self._zero3_axis, arr.shape[0]):
+            return None
+        # tensor-parallel block params keep the GSPMD path: their tp
+        # placement and the manual dp gather would fight over layout
+        for _, p in self.blocks[0].named_parameters():
+            spec = getattr(p, "pspec", None)
+            if spec and any(a in mesh.axis_names and mesh.shape[a] > 1
+                            for a in tuple(spec) if a is not None):
+                return None
+        return mesh
+
     def _scan_ok(self, attn_mask) -> bool:
         cfg = self.cfg
         if (not self._scan_layers or attn_mask is not None
@@ -588,12 +621,28 @@ class GPTModel(Layer):
         use_remat = self._recompute and self.training
         pol = checkpoint_policy(getattr(self, "_recompute_policy", None)) \
             if use_remat else None
+        z3_mesh = self._zero3_mesh(x)
 
         def scan_fn(h, *flat_arrs):
             stacked = {
                 name: jnp.stack([flat_arrs[b * n_names + j]
                                  for b in range(n_layers)])
                 for j, name in enumerate(names)}
+
+            if z3_mesh is not None:
+                # ZeRO-3 overlapped gather: shard_map over dp with the
+                # next layer's all-gather riding under this layer's
+                # compute (distributed.zero3)
+                from ..distributed.zero3 import scan_layers_zero3
+
+                def call_block(layer_params, carry):
+                    out, _ = functional_call(blk0, layer_params, {},
+                                             carry)
+                    return out
+
+                return scan_layers_zero3(
+                    call_block, stacked, h, z3_mesh, self._zero3_axis,
+                    use_remat=use_remat, policy=pol)
 
             def body(carry, layer_params):
                 out, _ = functional_call(blk0, layer_params, {}, carry)
@@ -608,7 +657,9 @@ class GPTModel(Layer):
             return out
 
         from ..core.autograd import apply
-        return apply(scan_fn, x, *flat, name="gpt_scan_layers")
+        return apply(scan_fn, x, *flat,
+                     name="gpt_scan_layers_zero3" if z3_mesh is not None
+                     else "gpt_scan_layers")
 
     # ---- serving path: static KV cache --------------------------------
     def init_kv_cache(self, batch_slots: int, capacity: Optional[int] = None,
@@ -727,6 +778,10 @@ class GPTForCausalLM(Layer):
 
     def enable_scan_layers(self, flag: bool = True):
         self.gpt.enable_scan_layers(flag)
+        return self
+
+    def enable_zero3_overlap(self, axis: str = "dp"):
+        self.gpt.enable_zero3_overlap(axis)
         return self
 
     def _tp_size(self) -> int:

@@ -1,0 +1,210 @@
+"""Per-step collective-communication breakdown from compiled HLO.
+
+PR 3 gave the trainer a host-sync counter and PR 4 a compile counter so
+perf contracts could be PROVEN; this is the same discipline for the
+communication the overlap schedules (`distributed.overlap`) claim to
+hide.  The optimized (post-GSPMD-partitioning) HLO of a compiled step
+names every collective XLA will run — all-reduce, all-gather,
+reduce-scatter, all-to-all, collective-permute, sync or async-`-start`
+form — with its per-device output shape.  Parsing it yields:
+
+- how many collectives one step issues, by kind;
+- the per-device bytes they move;
+- ``comm_ms``: those bytes over an interconnect-bandwidth model
+  (``PADDLE_TPU_ICI_GBPS`` overrides; public per-chip ICI figures
+  otherwise; a nominal loopback figure on the host backend) — an
+  ESTIMATE of the exposed-serial transfer time, which the trainers
+  divide by the measured step time for ``comm_fraction``.
+
+The parse is deterministic and backend-honest (it reads what XLA will
+actually execute, not what the Python source asked for), so tests can
+assert e.g. "the ZeRO-3 overlap step gathers params with all-gather and
+returns grads with reduce-scatter" structurally.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+__all__ = ["parse_hlo_collectives", "estimate_comm_ms",
+           "analyze_compiled", "analyze_jit", "COLLECTIVE_KINDS"]
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+# `%all-gather.3 = f32[4,16]{1,0} all-gather(` — capture the result
+# shape(s) and the op kind.  Tuple shapes (variadic collectives) may
+# carry `/*index=N*/` comments and layout annotations with nested
+# parens (`{:T(8,128)}` tiling on TPU), so the tuple match allows one
+# paren nesting level.  Async collectives appear as `-start`/`-done`
+# pairs; only the start carries the transfer (the done is bookkeeping).
+_OP_RE = re.compile(
+    r"=\s+(?P<shape>\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[0-9,]*\]\S*)"
+    r"\s+(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<async>-start)?\(")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_text: str, async_start: bool = False,
+                 kind: str = "") -> int:
+    """Bytes of one HLO shape literal (tuples sum their elements).
+
+    async_start: an async `-start` op's tuple shape is
+    (operand, result[, contexts...]) — only the RESULT is wire traffic.
+    Context elements (u32[] sync tokens, e.g. the trailing pair of
+    collective-permute-start) are dropped by an absolute tiny-size
+    filter, then the result is picked by op kind: reduce-scatter's
+    result is the SMALLEST data buffer (operand/groupsize — a relative
+    filter would misclassify it as context at large group sizes), every
+    other kind's result is the largest (gather grows, reduce/permute
+    keep the operand size, where max == the result)."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * size)
+    if async_start and len(sizes) > 1:
+        data = [s for s in sizes if s > 8] or sizes
+        return min(data) if kind == "reduce-scatter" else max(data)
+    return sum(sizes)
+
+
+# computation header: `%region_0.26_spmd (param: ...) -> ... {` (op
+# lines are excluded by the absence of ` = `)
+_COMP_RE = re.compile(r"\s*(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+_WHILE_RE = re.compile(
+    r"condition=%([\w.\-]+).*?body=%([\w.\-]+)|"
+    r"body=%([\w.\-]+).*?condition=%([\w.\-]+)")
+_CONST_RE = re.compile(r"=\s*[su]\d+\[\]\S*\s+constant\((\d+)\)")
+
+
+def _while_multipliers(lines_by_comp):
+    """comp name -> execution multiplier: a collective inside a
+    while-body computation runs once per loop trip (a lax.scan body:
+    num_layers trips for the ZeRO-3 layer scan, M+2(pp-1) ticks for
+    1F1B), and nested scans multiply.  Trip counts come from the loop
+    condition's `i < constant(N)` compare; an unparseable condition
+    falls back to 1 (i.e. the old static count — never overcounting)."""
+    parent_of = {}   # body comp -> (trip, comp containing the while)
+    for comp, lines in lines_by_comp.items():
+        for ln in lines:
+            if " while(" not in ln:
+                continue
+            m = _WHILE_RE.search(ln)
+            if not m:
+                continue
+            cond, body = (m.group(1), m.group(2)) if m.group(1) \
+                else (m.group(4), m.group(3))
+            consts = [int(c) for cl in lines_by_comp.get(cond, [])
+                      for c in _CONST_RE.findall(cl)]
+            has_cmp = any("compare(" in cl and "direction=L" in cl
+                          for cl in lines_by_comp.get(cond, []))
+            trip = max(consts) if (consts and has_cmp) else 1
+            parent_of[body] = (max(trip, 1), comp)
+
+    def mult(comp, seen=()):
+        if comp in seen or comp not in parent_of:
+            return 1
+        trip, parent = parent_of[comp]
+        return trip * mult(parent, seen + (comp,))
+
+    return {comp: mult(comp) for comp in lines_by_comp}
+
+
+def parse_hlo_collectives(hlo_text: str) -> Dict:
+    """Scan optimized HLO for collective ops.
+
+    Returns {"count": int, "bytes": int, "by_op": {kind: {"count", "bytes"}}}
+    — bytes are per-device output bytes per STEP: async `-done` ops and
+    the tuple-carrying `-start` intermediates are not double counted,
+    and a collective inside a while/scan body counts once per loop trip
+    (the scanned schedules — ZeRO-3 layer gathers, 1F1B tick ppermutes
+    — would otherwise underreport by the trip count)."""
+    lines_by_comp: Dict[str, list] = {"": []}
+    comp = ""
+    for line in hlo_text.splitlines():
+        stripped = line.rstrip()
+        if stripped.endswith("{") and " = " not in stripped:
+            m = _COMP_RE.match(stripped)
+            if m:
+                comp = m.group(1)
+                lines_by_comp.setdefault(comp, [])
+                continue
+        lines_by_comp.setdefault(comp, []).append(line)
+    mults = _while_multipliers(lines_by_comp)
+
+    by_op = {k: {"count": 0, "bytes": 0} for k in COLLECTIVE_KINDS}
+    for comp, lines in lines_by_comp.items():
+        scale = mults.get(comp, 1)
+        for line in lines:
+            for m in _OP_RE.finditer(line):
+                kind = m.group("kind")
+                by_op[kind]["count"] += scale
+                by_op[kind]["bytes"] += scale * _shape_bytes(
+                    m.group("shape"),
+                    async_start=bool(m.group("async")), kind=kind)
+    total_c = sum(v["count"] for v in by_op.values())
+    total_b = sum(v["bytes"] for v in by_op.values())
+    return {"count": total_c, "bytes": total_b,
+            "by_op": {k: v for k, v in by_op.items() if v["count"]}}
+
+
+# public per-chip ICI bandwidth figures (GB/s, order-of-magnitude — the
+# model is for a fraction, not a benchmark); host backend gets a nominal
+# shared-memory figure so CPU dryruns report a non-degenerate fraction.
+_ICI_GBPS = {
+    "v2": 60.0, "v3": 70.0, "v4": 100.0, "v5 lite": 40.0, "v5e": 40.0,
+    "v5p": 120.0, "v5": 120.0, "v6 lite": 90.0, "v6e": 90.0,
+}
+_HOST_GBPS = 8.0
+
+
+def _bandwidth_gbps(device=None) -> float:
+    env = os.environ.get("PADDLE_TPU_ICI_GBPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower() if device else ""
+    for key in sorted(_ICI_GBPS, key=len, reverse=True):
+        if key in kind:
+            return _ICI_GBPS[key]
+    return _HOST_GBPS
+
+
+def estimate_comm_ms(n_bytes: int, device=None) -> float:
+    """Transfer-time estimate for `n_bytes` per-device collective bytes
+    under the bandwidth model (PADDLE_TPU_ICI_GBPS overrides)."""
+    bw = _bandwidth_gbps(device) * 1e9
+    return (n_bytes / bw) * 1e3 if bw > 0 else 0.0
+
+
+def analyze_compiled(compiled, device=None) -> Dict:
+    """Collective breakdown + comm_ms estimate of one compiled XLA
+    executable (a `jax.stages.Compiled`)."""
+    txt = compiled.as_text()
+    out = parse_hlo_collectives(txt)
+    out["comm_ms"] = round(estimate_comm_ms(out["bytes"], device), 4)
+    return out
+
+
+def analyze_jit(jitfn, *args, device=None) -> Optional[Dict]:
+    """AOT lower+compile `jitfn` at `args` (values or ShapeDtypeStructs)
+    and analyze its collectives.  Returns None when lowering fails (the
+    caller's step still runs; stats just stay unmeasured) — comm stats
+    are diagnostics and must never take the training step down."""
+    try:
+        return analyze_compiled(jitfn.lower(*args).compile(),
+                                device=device)
+    except Exception:
+        return None
