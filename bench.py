@@ -246,17 +246,24 @@ def _transient_compile_error(e) -> bool:
         "RESOURCE_EXHAUSTED", "ResourceExhausted"))
 
 
-def bench_train_retry(config_name, batch, seq, steps, warmup,
-                      use_flash=True, remat=None, tries=3):
-    """bench_train with backoff retries on transient compile failures.
+def _backoff_s(attempt, base=15.0, cap=180.0):
+    """Exponential backoff with full jitter: a degraded remote-compile
+    helper recovers on its own schedule, and N clients hammering it in
+    lockstep (the round-4 failure mode: fixed linear waits) just extend
+    the brownout.  base·2^attempt capped, scaled by U[0.5, 1.5)."""
+    import random
+    return min(cap, base * (2 ** attempt)) * (0.5 + random.random())
 
-    Round 4's number collapsed because every sweep point died on a
-    degraded remote-compile helper (HTTP 500) and there was no retry.
-    """
+
+def _retry_transient(fn, tries=3, label="bench"):
+    """Run fn() with bounded exponential-backoff+jitter retries on
+    TRANSIENT compile/execute failures (_transient_compile_error); real
+    errors propagate immediately.  Shared by the train sweep and the
+    serve/loadtest paths — run r04 was lost to a 500ing compile helper
+    with no retry around the measured config."""
     for attempt in range(tries):
         try:
-            return bench_train(config_name, batch, seq, steps, warmup,
-                               use_flash=use_flash, remat=remat)
+            return fn()
         except Exception as e:
             if not (attempt + 1 < tries and _transient_compile_error(e)):
                 raise
@@ -271,10 +278,23 @@ def bench_train_retry(config_name, batch, seq, steps, warmup,
             _jax.clear_caches()
         except Exception:
             pass
-        wait = 20 * (attempt + 1)
-        log(f"  transient compile failure ({msg}); "
-            f"retry {attempt + 2}/{tries} in {wait}s")
+        wait = _backoff_s(attempt)
+        log(f"  {label}: transient compile failure ({msg}); "
+            f"retry {attempt + 2}/{tries} in {wait:.0f}s")
         time.sleep(wait)
+
+
+def bench_train_retry(config_name, batch, seq, steps, warmup,
+                      use_flash=True, remat=None, tries=3):
+    """bench_train with backoff retries on transient compile failures.
+
+    Round 4's number collapsed because every sweep point died on a
+    degraded remote-compile helper (HTTP 500) and there was no retry.
+    """
+    return _retry_transient(
+        lambda: bench_train(config_name, batch, seq, steps, warmup,
+                            use_flash=use_flash, remat=remat),
+        tries=tries, label=f"{config_name} b{batch}")
 
 
 def bench_flash(seqs=(1024, 2048, 4096), batch=8):
@@ -464,6 +484,131 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
     print(json.dumps(out))
 
 
+def bench_loadtest(smoke=False):
+    """`--serve --loadtest`: open-loop Poisson load test against the
+    PAGED engine (block-pool KV + radix prefix cache) — p50/p99
+    time-to-first-token, tokens/sec, slot AND block-pool occupancy,
+    prefix-cache hit rate, preemptions.  `--serve --loadtest --smoke`
+    is the CPU dry run / CI contract: a few dozen Poisson arrivals with
+    shared-prefix prompts must run with ZERO XLA compiles after warmup,
+    drain the block pool leak-free (free == total), and score a
+    prefix-cache hit rate > 0."""
+    import jax
+    import paddle_tpu as paddle
+    from dataclasses import replace
+    from paddle_tpu.distributed import async_dispatch
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.inference.loadgen import (SharedPrefixWorkload,
+                                              run_loadtest)
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_configs
+    from paddle_tpu.utils import compile_counter
+    from paddle_tpu.utils.compile_cache import ensure_compile_cache
+
+    cache_dir = ensure_compile_cache()
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if smoke or not on_tpu:
+        config_name, seq, slots = "gpt3-tiny", 64, 4
+        block_size, num_blocks = 8, 28
+        num_requests, rate_rps = 24, 100.0
+        # two buckets cover the whole smoke workload (prompts <= 28,
+        # roomy 28-block pool => no preemption resumes past 32); fewer
+        # buckets = fewer warmup executables = cheaper tier-1 smoke
+        buckets = [16, 32]
+        wl_kw = dict(shared_frac=0.6, prefix_len=16, tail_len=(3, 12),
+                     max_new=(4, 10))
+    else:
+        buckets = None
+        config_name = os.environ.get("BENCH_CONFIG", "gpt3-125m")
+        seq = int(os.environ.get("BENCH_SEQ", 2048))
+        slots = int(os.environ.get("PADDLE_TPU_DECODE_SLOTS", 8))
+        block_size = int(os.environ.get("PADDLE_TPU_KV_BLOCK_SIZE", 128))
+        num_blocks = int(os.environ.get("PADDLE_TPU_KV_BLOCKS", 0)) or None
+        num_requests = int(os.environ.get("BENCH_LOAD_REQUESTS",
+                                          4 * slots))
+        rate_rps = float(os.environ.get("BENCH_LOAD_RPS", 4.0))
+        wl_kw = dict(shared_frac=0.5, prefix_len=2 * block_size,
+                     tail_len=(16, 128), max_new=(32, 96))
+    cfg = replace(gpt_configs()[config_name], max_seq_len=seq,
+                  fused_ce=False)
+    log(f"loadtest: {config_name} slots={slots} block_size={block_size} "
+        f"requests={num_requests} rate={rate_rps}/s "
+        f"({cfg.num_params() / 1e6:.0f}M params)")
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    eng = InferenceEngine(model, batch_slots=slots, kv_layout="paged",
+                          kv_block_size=block_size,
+                          kv_num_blocks=num_blocks,
+                          prefill_buckets=buckets)
+    t0 = time.perf_counter()
+    # every bucket's cold AND traced-prefix prefill + decode + sample:
+    # Poisson traffic (incl. preemption resumes) may touch any of them,
+    # and the measured window must stay compile-free
+    eng.warmup(buckets=eng.buckets)
+    warmup_s = time.perf_counter() - t0
+    log(f"  warmup+compile {warmup_s:.1f}s "
+        f"(cold {eng.stats['compile_ms_cold']:.0f}ms)")
+
+    workload = SharedPrefixWorkload(cfg.vocab_size, seed=0, **wl_kw)
+    snap = compile_counter.snapshot()
+    async_dispatch.reset_host_sync_count()
+    report = run_loadtest(eng, num_requests, rate_rps, workload=workload)
+    st = eng.stats
+    out = {
+        "metric": "gpt_serve_loadtest",
+        "value": report["tokens_per_sec"],
+        "unit": "tok/s",
+        "config": config_name,
+        "batch_slots": slots,
+        **report,
+        "decode_steps": st["decode_steps"],
+        "xla_compiles_measured": snap.new_compiles,
+        "jaxpr_traces_measured": snap.new_traces,
+        "host_syncs_measured": async_dispatch.host_sync_count(),
+        "warmup_s": round(warmup_s, 2),
+        "compile_ms_cold": st["compile_ms_cold"],
+        "compile_cache_dir": cache_dir,
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    log(f"  loadtest: {out['value']} tok/s, TTFT p50 "
+        f"{report['ttft_ms_p50']}ms p99 {report['ttft_ms_p99']}ms, "
+        f"block occupancy {report.get('block_occupancy')}, prefix hit "
+        f"rate {report.get('prefix_hit_rate')}, "
+        f"preemptions {report['preemptions']}, compiles in window: "
+        f"{snap.new_compiles}")
+
+    if smoke:
+        if snap.new_compiles != 0:
+            raise SystemExit(
+                f"loadtest --smoke: {snap.new_compiles} XLA compiles "
+                f"during the Poisson window (expected 0 after warmup — "
+                f"the paged decode/prefill path is not shape-stable)")
+        # leak check: flush the radix cache, then EVERY pool block must
+        # be back on the free list (free == total)
+        try:
+            eng.check_leak_free()
+        except AssertionError as e:
+            raise SystemExit(f"loadtest --smoke: {e}")
+        if not report.get("prefix_hit_rate"):
+            raise SystemExit(
+                "loadtest --smoke: prefix-cache hit rate is 0 on a "
+                "shared-prefix workload — radix matching is broken")
+        if report["num_requests"] < num_requests:
+            raise SystemExit(
+                f"loadtest --smoke: only {report['num_requests']}/"
+                f"{num_requests} requests completed")
+        out["metric"] = "loadtest_smoke"
+        out["ok"] = True
+        out["kv_blocks_free_at_drain"] = eng._alloc.num_free
+        log(f"  loadtest smoke ok: {report['tokens_generated']} tokens, "
+            f"0 compiles, pool drained "
+            f"{eng._alloc.num_free}/{eng._alloc.capacity} free, "
+            f"hit rate {report['prefix_hit_rate']}")
+    print(json.dumps(out))
+
+
 def bench_multichip_child():
     """Child half of --multichip-smoke (runs with JAX_PLATFORMS=cpu and
     8 virtual host devices): executes the shared overlap-parity phases
@@ -563,7 +708,20 @@ def main():
         f"kind={getattr(dev, 'device_kind', '?')}")
 
     if "--serve" in sys.argv:
-        bench_serve(smoke="--smoke" in sys.argv)
+        smoke = "--smoke" in sys.argv
+        if "--loadtest" in sys.argv:
+            if smoke or not on_tpu:
+                bench_loadtest(smoke=smoke)
+            else:
+                # the measured config rides the same transient-failure
+                # retry as the train sweep (ROADMAP item 1)
+                _retry_transient(lambda: bench_loadtest(smoke=False),
+                                 tries=3, label="loadtest")
+        elif smoke or not on_tpu:
+            bench_serve(smoke=smoke)
+        else:
+            _retry_transient(lambda: bench_serve(smoke=False),
+                             tries=3, label="serve")
         return
 
     if "--multichip-child" in sys.argv:

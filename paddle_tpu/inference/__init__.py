@@ -8,6 +8,10 @@ non-Python serving stacks can load the same artifact.
 from ..jit.api import load as load_predictor  # noqa: F401
 from .engine import (  # noqa: F401
     InferenceEngine, Request, default_prefill_buckets)
+from .paged_kv import (  # noqa: F401
+    BlockAllocator, PagedKVCache, blocks_for, init_paged_cache)
+from .prefix_cache import RadixPrefixCache  # noqa: F401
 
 __all__ = ["load_predictor", "InferenceEngine", "Request",
-           "default_prefill_buckets"]
+           "default_prefill_buckets", "PagedKVCache", "BlockAllocator",
+           "RadixPrefixCache", "blocks_for", "init_paged_cache"]

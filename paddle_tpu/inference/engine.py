@@ -2,24 +2,34 @@
 
 The training side of this repo got its fast path in PRs 1-3 (fused
 kernels, async dispatch, persistent compile cache); this module is the
-same discipline for inference, built from two papers:
+same discipline for inference, built from three papers:
 
 - Pope et al., *Efficiently Scaling Transformer Inference*: ONE compiled
   **prefill** executable per prompt-length bucket writing into a
-  statically-shaped, preallocated KV cache
-  (``models.gpt.StaticKVCache``, layout
-  ``[layers, batch_slots, max_seq, kv_heads, head_dim]``), and ONE
-  compiled **decode** executable appending a single token per slot and
-  running the fused single-token attention kernel
-  (``ops.decode_attention``) over the cache.  Nothing in the decode loop
-  ever changes shape, so generating N tokens costs ZERO new XLA
-  compiles (the contract ``bench.py --serve --smoke`` and
-  tests/test_inference_engine.py assert via utils.compile_counter).
+  statically-shaped, preallocated KV cache, and ONE compiled **decode**
+  executable appending a single token per slot and running the fused
+  single-token attention kernel (``ops.decode_attention``) over the
+  cache.  Nothing in the decode loop ever changes shape, so generating N
+  tokens costs ZERO new XLA compiles (the contract ``bench.py --serve
+  --smoke`` and the engine tests assert via utils.compile_counter).
 - Yu et al., *Orca*: **continuous batching** — the decode batch is a set
   of fixed ``batch_slots``; new requests are admitted into free slots
-  BETWEEN decode steps (a prefill touches only its slot's cache rows),
-  and finished requests retire their slot immediately instead of making
-  short requests wait for the longest one in a static batch.
+  BETWEEN decode steps, and finished requests retire their slot
+  immediately instead of making short requests wait for the longest one
+  in a static batch.
+- Kwon et al., *PagedAttention* (vLLM): with ``kv_layout='paged'`` the
+  cache is a BLOCK POOL (``inference.paged_kv.PagedKVCache``) and each
+  slot holds a block table, so a slot consumes memory proportional to
+  its ACTUAL length — admission is by free-block count, not free slots,
+  and short requests no longer strand ``max_seq`` rows each.  A radix
+  prefix cache (``inference.prefix_cache``) shares prompt-prefix blocks
+  between requests so common system prompts prefill once; on pool
+  exhaustion the scheduler first evicts unpinned cache blocks, then
+  PREEMPTS the youngest request back onto the queue (it resumes later
+  via a prefill over prompt+generated — which usually hits the radix
+  cache) instead of deadlocking.  ``kv_layout='dense'`` (default) keeps
+  the PR-4 ``StaticKVCache`` and is the parity oracle for the paged
+  path.
 
 Sampling (greedy / temperature / top-k / top-p) runs inside the decode
 executable, so each step costs exactly one host read-back — the sampled
@@ -35,9 +45,12 @@ rollback) — the compile-cache guard plus no-donation keeps the test
 suite's warm cache safe.  On TPU, donation is on and the cache updates
 are true in-place writes.
 
-Knobs: ``PADDLE_TPU_DECODE_SLOTS`` (default 8) and
+Knobs: ``PADDLE_TPU_DECODE_SLOTS`` (default 8),
 ``PADDLE_TPU_PREFILL_BUCKETS`` (comma-separated lengths; default powers
-of two up to max_seq_len).
+of two up to max_seq_len), ``PADDLE_TPU_KV_LAYOUT`` (dense|paged),
+``PADDLE_TPU_KV_BLOCK_SIZE`` (default 128), ``PADDLE_TPU_KV_BLOCKS``
+(usable pool blocks; default = dense-equivalent memory), and
+``PADDLE_TPU_PREFIX_CACHE`` (default on for paged).
 """
 from __future__ import annotations
 
@@ -55,6 +68,8 @@ import jax.numpy as jnp
 from ..distributed import async_dispatch
 from ..func import functional_apply, functional_state
 from ..utils import compile_cache, compile_counter
+from .paged_kv import BlockAllocator, blocks_for, init_paged_cache
+from .prefix_cache import RadixPrefixCache
 
 __all__ = ["InferenceEngine", "Request", "default_prefill_buckets"]
 
@@ -92,6 +107,28 @@ class Request:
         self.generated: List[int] = []
         self.slot: Optional[int] = None
         self.done = False
+        # per-request latency accounting (stats / load harness)
+        self.t_enqueue = time.perf_counter()
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        # decode wall-clock summed over ACTIVATIONS only (a preempted
+        # request's requeue wait must not dilute its decode tok/s),
+        # and queue wait summed over WAITS only (symmetrically, active
+        # decode time must not inflate queued_ms)
+        self.active_s = 0.0
+        self.t_live: Optional[float] = None
+        self.queued_s = 0.0
+        self.t_queue_since = self.t_enqueue
+        # preemption support: a preempted request resumes via a prefill
+        # over prompt+generated-so-far (this field), keeping `generated`
+        self.resume_prompt: Optional[np.ndarray] = None
+        self.preemptions = 0
+        self.admit_seq: Optional[int] = None
+
+    def effective_prompt(self) -> np.ndarray:
+        return self.prompt if self.resume_prompt is None \
+            else self.resume_prompt
 
 
 class InferenceEngine:
@@ -99,20 +136,26 @@ class InferenceEngine:
 
     Usage::
 
-        eng = InferenceEngine(model, batch_slots=8)
+        eng = InferenceEngine(model, batch_slots=8, kv_layout="paged")
         rid = eng.add_request(prompt_ids, max_new_tokens=64, eos_id=eos)
         outputs = eng.run()          # {rid: np.int32 generated tokens}
 
     or incrementally: ``eng.step()`` admits queued requests into free
     slots and decodes one token for every active slot; finished
-    requests appear in ``eng.results``.
+    requests appear in ``eng.results``.  ``eng.generate(prompt)`` is the
+    blocking single-request form: it goes through the same admission
+    queue, so on a full engine it WAITS for capacity instead of raising.
     """
 
     def __init__(self, model, batch_slots: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
                  cache_dtype=None, top_k: int = 0, seed: int = 0,
-                 mesh=None, donate: Optional[bool] = None):
+                 mesh=None, donate: Optional[bool] = None,
+                 kv_layout: Optional[str] = None,
+                 kv_block_size: Optional[int] = None,
+                 kv_num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         model.eval()
         self.model = model
         cfg = model.cfg
@@ -126,6 +169,11 @@ class InferenceEngine:
         self.buckets = sorted(prefill_buckets or
                               default_prefill_buckets(self.max_seq_len))
         self.top_k = int(top_k)
+        self.kv_layout = (kv_layout or
+                          os.environ.get("PADDLE_TPU_KV_LAYOUT", "dense"))
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(f"kv_layout must be dense|paged, got "
+                             f"{self.kv_layout!r}")
 
         # persistent compile cache: a restarted server deserializes its
         # prefill/decode executables instead of recompiling them
@@ -133,11 +181,17 @@ class InferenceEngine:
         compile_counter.install()
 
         self.params, _ = functional_state(model)
-        self.cache = model.init_kv_cache(self.batch_slots,
-                                         self.max_seq_len, cache_dtype)
         self.mesh = mesh
-        if mesh is not None:
-            self._shard_over_mesh(mesh)
+        if self.kv_layout == "paged":
+            self._init_paged(cache_dtype, kv_block_size, kv_num_blocks,
+                             prefix_cache)
+        else:
+            self.cache = model.init_kv_cache(self.batch_slots,
+                                             self.max_seq_len, cache_dtype)
+            self._alloc = None
+            self._prefix = None
+            if mesh is not None:
+                self._shard_over_mesh(mesh)
 
         # CPU + persistent cache + donation = the PR 2 mis-alias hazard
         # (deserialized executables alias donated buffers wrongly on
@@ -157,6 +211,12 @@ class InferenceEngine:
         dargs = (1,) if self._donate else ()
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dargs)
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dargs)
+        self._prefill_paged_cold_jit = jax.jit(
+            self._prefill_paged_cold_fn, donate_argnums=dargs)
+        self._prefill_paged_ext_jit = jax.jit(
+            self._prefill_paged_ext_fn, donate_argnums=dargs)
+        self._decode_paged_jit = jax.jit(
+            self._decode_paged_fn, donate_argnums=dargs)
         self._sample_jit = jax.jit(self._sample_from_logits)
 
         self._key = jax.random.PRNGKey(int(seed))
@@ -168,22 +228,63 @@ class InferenceEngine:
         self._slot_len = np.zeros(self.batch_slots, np.int64)
         self._temps = np.zeros(self.batch_slots, np.float32)
         self._top_ps = np.ones(self.batch_slots, np.float32)
+        self._admit_counter = itertools.count()
         self.results: Dict[int, np.ndarray] = {}
+        self.request_stats: Dict[int, dict] = {}
+        self._request_stats_cap = 4096     # bounded per-request history
+        self._results_cap = 65536          # results eviction safety net
 
         # stats machinery (same shape as SpmdTrainer._timings/stats)
         self._timings = {
             "prefill_ms": 0.0, "decode_ms": 0.0, "sync_ms": 0.0,
-            "compile_ms_cold": 0.0, "prefills": 0, "decode_steps": 0,
-            "tokens_generated": 0, "occupancy_sum": 0.0,
+            "compile_ms_cold": 0.0, "prefills": 0, "prefill_tokens": 0,
+            "decode_steps": 0, "tokens_generated": 0,
+            "occupancy_sum": 0.0, "block_occupancy_sum": 0.0,
+            "preemptions": 0, "memory_capped_retirements": 0,
         }
         self._first_call_keys: set = set()
         self._counters0 = compile_counter.snapshot()
 
+    # ---- paged layout setup -------------------------------------------
+    def _init_paged(self, cache_dtype, kv_block_size, kv_num_blocks,
+                    prefix_cache):
+        """Block pool + allocator + host block tables + radix cache.
+        Default pool size is DENSE-EQUIVALENT memory (batch_slots ×
+        ceil(max_seq/bs) blocks) so the layouts A/B at equal footprint;
+        real deployments size it to the HBM actually available
+        (``PADDLE_TPU_KV_BLOCKS``)."""
+        bs = int(kv_block_size or
+                 os.environ.get("PADDLE_TPU_KV_BLOCK_SIZE", 128))
+        if bs < 1:
+            raise ValueError(f"kv_block_size must be >= 1, got {bs}")
+        self.block_size = bs
+        self.blocks_per_slot = blocks_for(self.max_seq_len, bs)
+        usable = int(kv_num_blocks or
+                     os.environ.get("PADDLE_TPU_KV_BLOCKS", 0)) or \
+            self.batch_slots * self.blocks_per_slot
+        self.num_blocks = usable
+        # +1: block 0 is the reserved null block unused table entries
+        # point at (paged_kv module docstring)
+        self.cache = init_paged_cache(self.model, usable + 1, bs,
+                                      cache_dtype)
+        self._alloc = BlockAllocator(usable + 1, bs)
+        self._tables = np.zeros((self.batch_slots, self.blocks_per_slot),
+                                np.int32)
+        self._slot_blocks: List[List[int]] = \
+            [[] for _ in range(self.batch_slots)]
+        if prefix_cache is None:
+            prefix_cache = os.environ.get("PADDLE_TPU_PREFIX_CACHE",
+                                          "1") != "0"
+        self._prefix = RadixPrefixCache(self._alloc, bs) \
+            if prefix_cache else None
+
     # ---- sharding -----------------------------------------------------
     def _shard_over_mesh(self, mesh):
-        """Place the cache like a training activation: batch_slots over
-        'dp', kv heads over 'tp' when those axes exist (best-effort —
-        a 1-device mesh or missing axes degrade to replicated)."""
+        """Place the dense cache like a training activation: batch_slots
+        over 'dp', kv heads over 'tp' when those axes exist (best-effort
+        — a 1-device mesh or missing axes degrade to replicated).  The
+        paged pool stays replicated for now: its block dimension has no
+        stable owner under continuous reallocation."""
         try:
             from jax.sharding import NamedSharding, PartitionSpec as P
             names = mesh.axis_names
@@ -202,6 +303,19 @@ class InferenceEngine:
     def _prefill_fn(self, params, cache, ids, slot, prompt_len):
         return functional_apply(self.model, "prefill", params,
                                 ids, cache, slot, prompt_len)
+
+    def _prefill_paged_cold_fn(self, params, cache, ids, table_row,
+                               suffix_len):
+        # prefix_len is a STATIC Python 0: the cold path compiles with
+        # the exact flash/composite attention of the dense prefill
+        return functional_apply(self.model, "prefill_paged", params,
+                                ids, cache, table_row, 0, suffix_len)
+
+    def _prefill_paged_ext_fn(self, params, cache, ids, table_row,
+                              prefix_len, suffix_len):
+        return functional_apply(self.model, "prefill_paged", params,
+                                ids, cache, table_row, prefix_len,
+                                suffix_len)
 
     def _sample_from_logits(self, logits, key, temps, top_ps):
         """Greedy when temps<=0, else temperature + (static) top-k +
@@ -234,6 +348,15 @@ class InferenceEngine:
         nxt = self._sample_from_logits(logits, sub, temps, top_ps)
         return nxt, key, cache
 
+    def _decode_paged_fn(self, params, cache, tokens, tables, lengths,
+                         key, temps, top_ps):
+        logits, cache = functional_apply(self.model, "decode_step_paged",
+                                         params, tokens, cache, tables,
+                                         lengths)
+        key, sub = jax.random.split(key)
+        nxt = self._sample_from_logits(logits, sub, temps, top_ps)
+        return nxt, key, cache
+
     # ---- timing helpers -----------------------------------------------
     def _timed(self, kind, key, fn):
         t0 = time.perf_counter()
@@ -257,7 +380,7 @@ class InferenceEngine:
                     eos_id: Optional[int] = None,
                     temperature: float = 0.0, top_p: float = 1.0) -> int:
         """Queue a generation request; returns its id. Admitted into a
-        free slot at the next step()."""
+        free slot (dense) / free blocks (paged) at the next step()."""
         req = Request(prompt, max_new_tokens, eos_id, temperature, top_p)
         if req.prompt.size > self.buckets[-1]:
             raise ValueError(
@@ -267,8 +390,35 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens leaves no room to "
                 f"generate within max_seq_len={self.max_seq_len}")
+        if self.kv_layout == "paged":
+            # can this request EVER run alone on an empty pool?  (its
+            # transient bucket-padded prefill, then its steady state)
+            bs = self.block_size
+            worst = max(
+                blocks_for(self._bucket_for(req.prompt.size), bs),
+                blocks_for(min(req.prompt.size + req.max_new_tokens,
+                               self.max_seq_len), bs))
+            if worst > self._alloc.capacity:
+                raise ValueError(
+                    f"request needs {worst} KV blocks but the pool only "
+                    f"has {self._alloc.capacity} — raise "
+                    f"PADDLE_TPU_KV_BLOCKS or shrink the request")
         self._queue.append(req)
         return req.rid
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None, temperature: float = 0.0,
+                 top_p: float = 1.0) -> np.ndarray:
+        """Blocking single-request generation THROUGH the admission
+        queue: on a busy/full engine this waits for capacity (driving
+        step() retires slots and frees blocks) instead of raising.
+        In-flight requests keep decoding while it waits."""
+        rid = self.add_request(prompt, max_new_tokens=max_new_tokens,
+                               eos_id=eos_id, temperature=temperature,
+                               top_p=top_p)
+        while rid not in self.results:
+            self.step_or_raise()
+        return self.results[rid]
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -276,17 +426,88 @@ class InferenceEngine:
                 return b
         return self.buckets[-1]
 
-    def _admit(self, req: Request, slot: int):
-        bucket = self._bucket_for(req.prompt.size)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :req.prompt.size] = req.prompt
-        plen = req.prompt.size
-        logits, cache = self._timed(
-            "prefill_ms", ("prefill", bucket), lambda: self._prefill_jit(
-                self.params, self.cache, jnp.asarray(ids),
-                np.int32(slot), np.int32(plen)))
-        self.cache = cache
-        # first generated token comes from the prefill logits
+    # ---- paged block accounting ---------------------------------------
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Allocate n blocks, evicting unpinned radix-cache blocks if
+        the free list alone cannot cover it."""
+        if n <= 0:
+            return []
+        out = self._alloc.alloc(n)
+        if out is None and self._prefix is not None:
+            self._prefix.evict(n - self._alloc.num_free)
+            out = self._alloc.alloc(n)
+        return out
+
+    def _free_slot_blocks(self, slot: int):
+        self._alloc.decref(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = 0
+        self._slot_len[slot] = 0
+
+    def _release_slot(self, req: Request):
+        """Shared slot teardown for retirement AND preemption — every
+        per-slot sampling field is reset in exactly one place."""
+        slot = req.slot
+        if self.kv_layout == "paged":
+            self._free_slot_blocks(slot)
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._top_ps[slot] = 1.0
+        req.slot = None
+
+    def _preempt(self, req: Request):
+        """Kick an active request back onto the queue head: free its
+        blocks now, resume later via a prefill over prompt+generated
+        (which usually hits the radix cache for the original prompt).
+        The sampled-but-unwritten last token is re-derived by that
+        prefill, so no state is lost."""
+        req.resume_prompt = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)])
+        req.preemptions += 1
+        now = time.perf_counter()
+        req.active_s += now - req.t_live
+        req.t_queue_since = now
+        self._timings["preemptions"] += 1
+        self._release_slot(req)
+        self._queue.appendleft(req)
+
+    def _preempt_for_blocks(self, n: int,
+                            exclude: Request) -> Optional[List[int]]:
+        """Pool is dry mid-decode: preempt the YOUNGEST other active
+        request(s) until n blocks come free (vLLM's recompute-style
+        preemption).  Only victims whose resume prefill fits a bucket
+        qualify — with default buckets that is everyone."""
+        while True:
+            out = self._alloc_blocks(n)
+            if out is not None:
+                return out
+            # a victim must be RESUMABLE: its prompt+generated fits a
+            # prefill bucket AND that bucket's cold admission fits the
+            # pool (else it could never re-admit and the queue stalls)
+            victims = [
+                r for r in self._slots
+                if r is not None and r is not exclude
+                and len(r.prompt) + len(r.generated) <= self.buckets[-1]
+                and blocks_for(
+                    self._bucket_for(len(r.prompt) + len(r.generated)),
+                    self.block_size) <= self._alloc.capacity]
+            if not victims:
+                return None
+            self._preempt(max(victims, key=lambda r: r.admit_seq))
+
+    # ---- admission ----------------------------------------------------
+    def _try_admit(self, req: Request, slot: int) -> bool:
+        """Admit into `slot` if capacity allows; False leaves the
+        request at the queue head (head-of-line order is FIFO)."""
+        if self.kv_layout == "dense":
+            self._admit_dense(req, slot)
+            return True
+        return self._admit_paged(req, slot)
+
+    def _record_admission(self, req: Request, slot: int, plen: int,
+                          logits):
+        """Shared tail of both admission paths: sample the first token
+        from the prefill logits, bind the request to its slot."""
         self._key, sub = jax.random.split(self._key)
         # np (not list) literals: a python-float list would lower an
         # extra convert_element_type executable on the admission path
@@ -297,8 +518,14 @@ class InferenceEngine:
                 np.asarray([req.top_p], np.float32)))
         tok = int(np.asarray(tok)[0])
         async_dispatch.record_host_sync()
+        now = time.perf_counter()
+        if req.t_first is None:
+            req.t_first = now
+        req.t_live = now
+        req.queued_s += req.t_admit - req.t_queue_since
         self._timings["prefills"] += 1
         req.slot = slot
+        req.admit_seq = next(self._admit_counter)
         self._slots[slot] = req
         self._slot_len[slot] = plen
         self._temps[slot] = req.temperature
@@ -307,44 +534,258 @@ class InferenceEngine:
         self._next_token[slot] = tok
         self._retire_if_done(req, tok)
 
+    def _admit_dense(self, req: Request, slot: int):
+        prompt = req.effective_prompt()
+        bucket = self._bucket_for(prompt.size)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :prompt.size] = prompt
+        plen = prompt.size
+        req.t_admit = time.perf_counter()
+        self._timings["prefill_tokens"] += bucket
+        logits, cache = self._timed(
+            "prefill_ms", ("prefill", bucket), lambda: self._prefill_jit(
+                self.params, self.cache, jnp.asarray(ids),
+                np.int32(slot), np.int32(plen)))
+        self.cache = cache
+        self._record_admission(req, slot, plen, logits)
+
+    def _admit_paged(self, req: Request, slot: int) -> bool:
+        """Paged admission: match the radix cache, allocate blocks for
+        the divergent suffix's bucket, prefill ONLY the suffix, then
+        trim the bucket-padding blocks and adopt the prompt into the
+        radix tree."""
+        bs = self.block_size
+        prompt = req.effective_prompt()
+        pc_stats0 = None
+        if self._prefix is not None:
+            # a blocked head-of-line request re-matches on every retry;
+            # roll the hit counters back on failure so the reported hit
+            # rate counts admissions, not retries
+            pc_stats0 = (self._prefix.queries, self._prefix.hit_queries,
+                         self._prefix.hit_blocks)
+            shared, prefix_len = self._prefix.match(prompt)
+        else:
+            shared, prefix_len = [], 0
+        # the bucket-padded extent must fit BOTH the slot's block table
+        # (coarse bucket sets can push prefix+bucket past max_seq) AND
+        # the whole pool (a large prefix hit on a shrunk pool can
+        # demand more blocks than exist — and the matched blocks are
+        # pinned by our own incref, so eviction could never save it):
+        # shed cached prefix blocks (recompute those tokens) until it
+        # does — prefix_len=0 always fits, because add_request already
+        # guaranteed blocks_for(bucket_for(prompt)) <= capacity
+        fit = min(self.blocks_per_slot, self._alloc.capacity)
+        shed = 0
+        while shared and blocks_for(
+                prefix_len + self._bucket_for(prompt.size - prefix_len),
+                bs) > fit:
+            shared = shared[:-1]
+            prefix_len -= bs
+            shed += 1
+        if shed and pc_stats0 is not None:
+            # shed blocks were never reused — keep the hit counters
+            # honest (a fully-shed match is not a hit at all)
+            self._prefix.hit_blocks -= shed
+            if not shared:
+                self._prefix.hit_queries -= 1
+        suffix = prompt[prefix_len:]
+        bucket = self._bucket_for(suffix.size)
+        need_total = blocks_for(prefix_len + bucket, bs)
+        # the slot's OWN reference on the shared prefix blocks, taken
+        # BEFORE any allocation: _alloc_blocks may evict radix leaves,
+        # and a matched block whose only reference is the tree's
+        # (refcount 1) would otherwise be freed and re-handed out as
+        # this same request's "fresh" suffix block — aliasing the block
+        # table and corrupting the shared prefix KV
+        self._alloc.incref(shared)
+        new_blocks = self._alloc_blocks(need_total - len(shared))
+        if new_blocks is None:
+            self._alloc.decref(shared)
+            if pc_stats0 is not None:
+                (self._prefix.queries, self._prefix.hit_queries,
+                 self._prefix.hit_blocks) = pc_stats0
+            return False                      # stay queued; retry later
+        blocks = list(shared) + new_blocks
+        req.t_admit = time.perf_counter()
+        # the prefix-cache win in one number: a hit admission prefills
+        # only the divergent suffix's bucket, not the whole prompt's
+        self._timings["prefill_tokens"] += bucket
+
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :suffix.size] = suffix
+        row = np.zeros(self.blocks_per_slot, np.int32)
+        row[:len(blocks)] = blocks
+        if prefix_len == 0:
+            logits, cache = self._timed(
+                "prefill_ms", ("prefill_paged", bucket),
+                lambda: self._prefill_paged_cold_jit(
+                    self.params, self.cache, jnp.asarray(ids),
+                    jnp.asarray(row), np.int32(suffix.size)))
+        else:
+            logits, cache = self._timed(
+                "prefill_ms", ("prefill_paged_ext", bucket),
+                lambda: self._prefill_paged_ext_jit(
+                    self.params, self.cache, jnp.asarray(ids),
+                    jnp.asarray(row), np.int32(prefix_len),
+                    np.int32(suffix.size)))
+        self.cache = cache
+
+        # trim: blocks past the REAL prompt extent only ever held bucket
+        # padding — return them to the pool immediately
+        plen = int(prefix_len + suffix.size)          # == prompt.size
+        keep = blocks_for(plen, bs)
+        if len(blocks) > keep:
+            self._alloc.decref(blocks[keep:])
+            blocks = blocks[:keep]
+        self._slot_blocks[slot] = blocks
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        # adopt the prompt's full blocks into the radix tree so the NEXT
+        # request sharing this prefix skips its prefill
+        if self._prefix is not None:
+            n_full = prompt.size // bs
+            if n_full:
+                self._prefix.insert(prompt[:n_full * bs],
+                                    blocks[:n_full])
+        self._record_admission(req, slot, plen, logits)
+        return True
+
+    def _ensure_decode_room(self):
+        """Before a decode step every active slot whose next write falls
+        past its block extent gets one fresh block — by free list, then
+        radix-cache eviction, then preemption of the youngest other
+        request.  This is the no-deadlock path ISSUE'd as
+        preempt-to-queue: the dense engine could never run out mid-
+        request, the paged one can."""
+        for slot in range(self.batch_slots):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            extent = len(self._slot_blocks[slot]) * self.block_size
+            if int(self._slot_len[slot]) < extent:
+                continue
+            nb = self._alloc_blocks(1)
+            if nb is None:
+                nb = self._preempt_for_blocks(1, exclude=req)
+            if nb is None:
+                # every OTHER active request has outgrown the largest
+                # bucket (un-resumable victims — possible with custom
+                # coarse bucket lists): degrade the requester, never
+                # the engine.  Preempt it if it can itself resume;
+                # otherwise retire it with the tokens it has (a
+                # memory-capped finish beats killing every request).
+                total = len(req.prompt) + len(req.generated)
+                if (total <= self.buckets[-1] and blocks_for(
+                        self._bucket_for(total), self.block_size)
+                        <= self._alloc.capacity):
+                    self._preempt(req)
+                else:
+                    self._timings["memory_capped_retirements"] += 1
+                    self._retire(req)
+                continue
+            if self._slots[slot] is None:
+                # the victim hunt preempted ... ourselves?  impossible
+                # (exclude=req), but keep the invariant obvious
+                self._alloc.decref(nb)
+                continue
+            idx = len(self._slot_blocks[slot])
+            self._slot_blocks[slot].append(nb[0])
+            self._tables[slot, idx] = nb[0]
+
     def _retire_if_done(self, req: Request, last_tok: int):
-        """EOS / max-new-tokens / capacity retirement; frees the slot."""
-        slot = req.slot
-        full = self._slot_len[slot] + 1 >= self.max_seq_len
+        """EOS / max-new-tokens / capacity retirement; frees the slot
+        (and, paged, its blocks — minus any the radix cache pins)."""
+        full = self._slot_len[req.slot] + 1 >= self.max_seq_len
         if (last_tok == req.eos_id
                 or len(req.generated) >= req.max_new_tokens or full):
-            req.done = True
-            self.results[req.rid] = np.asarray(req.generated, np.int32)
-            self._slots[slot] = None
-            self._temps[slot] = 0.0
-            self._top_ps[slot] = 1.0
-            req.slot = None
+            self._retire(req)
+
+    def _retire(self, req: Request):
+        req.done = True
+        req.t_finish = time.perf_counter()
+        req.active_s += req.t_finish - req.t_live
+        self.results[req.rid] = np.asarray(req.generated, np.int32)
+        self.request_stats[req.rid] = self._request_record(req)
+        # bounded history: a long-running server must not grow state
+        # per request forever.  results is the DELIVERY channel — a
+        # step()-driven server is expected to pop what it consumes
+        # (loadgen does) — so its safety cap is generous enough that
+        # no realistic single run() batch ever hits it.
+        while len(self.request_stats) > self._request_stats_cap:
+            self.request_stats.pop(next(iter(self.request_stats)))
+        while len(self.results) > self._results_cap:
+            self.results.pop(next(iter(self.results)))
+        self._release_slot(req)
+
+    def _request_record(self, req: Request) -> dict:
+        n = len(req.generated)
+        return {
+            "prompt_tokens": int(req.prompt.size),
+            "tokens": n,
+            "ttft_ms": round((req.t_first - req.t_enqueue) * 1e3, 3),
+            "queued_ms": round(req.queued_s * 1e3, 3),
+            # over ACTIVE decode time only — requeue waits excluded
+            "decode_tokens_per_sec": round((n - 1) / req.active_s, 2)
+            if n > 1 and req.active_s > 0 else None,
+            "preemptions": req.preemptions,
+        }
 
     @property
     def num_active(self) -> int:
         return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def blocks_in_use(self) -> Optional[int]:
+        return self._alloc.num_in_use if self._alloc else None
 
     def step(self) -> int:
         """Admit queued requests into free slots, then decode one token
         for every active slot. Returns the number of tokens produced
         this step (admission prefills included)."""
         produced = 0
-        for slot, occ in enumerate(self._slots):
-            if occ is None and self._queue:
-                # each admission produces its first token from the
-                # prefill logits
-                self._admit(self._queue.popleft(), slot)
-                produced += 1
+        for slot in range(self.batch_slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            # paged admission is by FREE BLOCKS, not just a free slot;
+            # head-of-line FIFO: if the head can't fit, nobody jumps it
+            if not self._try_admit(self._queue[0], slot):
+                break
+            self._queue.popleft()
+            produced += 1
         active_np = np.asarray(
             [1 if r is not None else 0 for r in self._slots], np.int32)
         if not active_np.any():
             return produced
+        if self.kv_layout == "paged":
+            self._ensure_decode_room()
+            # a preemption/memory-capped retirement may have emptied
+            # slots; refresh the mask BEFORE accumulating occupancy so
+            # the stats describe the decode step that actually runs
+            active_np = np.asarray(
+                [1 if r is not None else 0 for r in self._slots],
+                np.int32)
+            if not active_np.any():
+                return produced
+            self._timings["block_occupancy_sum"] += \
+                self._alloc.num_in_use / self._alloc.capacity
         self._timings["occupancy_sum"] += float(active_np.mean())
-        nxt, self._key, cache = self._timed(
-            "decode_ms", ("decode", 0), lambda: self._decode_jit(
-                self.params, self.cache, jnp.asarray(self._next_token),
-                jnp.asarray(active_np), self._key,
-                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        if self.kv_layout == "paged":
+            nxt, self._key, cache = self._timed(
+                "decode_ms", ("decode", 0),
+                lambda: self._decode_paged_jit(
+                    self.params, self.cache,
+                    jnp.asarray(self._next_token),
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._slot_len.astype(np.int32)),
+                    self._key, jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ps)))
+        else:
+            nxt, self._key, cache = self._timed(
+                "decode_ms", ("decode", 0), lambda: self._decode_jit(
+                    self.params, self.cache,
+                    jnp.asarray(self._next_token),
+                    jnp.asarray(active_np), self._key,
+                    jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
         self.cache = cache
         # the ONE host sync of the decode step: the scheduler needs the
         # sampled ids for EOS retirement and admission
@@ -365,20 +806,54 @@ class InferenceEngine:
             self._retire_if_done(req, tok)
         return produced
 
+    def step_or_raise(self) -> int:
+        """step(), turning a wedged scheduler into an error: zero
+        progress with nothing active to retire but a non-empty queue
+        can never resolve on its own.  All blocking drivers (run /
+        generate / the load harness) share this one stall check."""
+        produced = self.step()
+        if produced == 0 and self.num_active == 0 and self._queue:
+            raise RuntimeError(
+                "admission stalled: queued requests but no free "
+                "capacity and nothing active to retire")
+        return produced
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.num_active > 0
+
     def run(self) -> Dict[int, np.ndarray]:
         """Drive step() until every queued request finished; returns
         {request_id: generated token ids}."""
-        while self._queue or self.num_active:
-            self.step()
+        while self.has_work:
+            self.step_or_raise()
         return self.results
+
+    def flush_prefix_cache(self) -> int:
+        """Drop every radix-cache node (slot-held blocks survive under
+        the slots' own references). Returns blocks released."""
+        return self._prefix.flush() if self._prefix is not None else 0
+
+    def check_leak_free(self):
+        """Drained-engine invariant: with no active slots, no queue and
+        a flushed prefix cache, every pool block must be free."""
+        assert self.num_active == 0 and not self._queue, \
+            "leak check requires a drained engine"
+        if self._alloc is not None:
+            self.flush_prefix_cache()
+            self._alloc.check_leak_free()
 
     def warmup(self, buckets: Optional[List[int]] = None):
         """Compile (or deserialize from the persistent cache) the decode
         + sampling executables and the given prefill buckets before
-        traffic arrives.  Uses slot 0 with throwaway tokens; the cache
-        lengths are reset afterwards so the garbage stays masked."""
+        traffic arrives.  Uses slot 0 (dense) / transient pool blocks
+        (paged) with throwaway tokens; lengths are reset afterwards so
+        the garbage stays masked.  Paged engines with a prefix cache
+        also compile the traced-prefix prefill executable per bucket."""
         assert self.num_active == 0 and not self._queue, \
             "warmup() must run before traffic"
+        if self.kv_layout == "paged":
+            return self._warmup_paged(buckets)
         for b in (buckets or [self.buckets[0]]):
             ids = jnp.zeros((1, b), jnp.int32)
             logits, cache = self._timed(
@@ -402,13 +877,61 @@ class InferenceEngine:
                                  jnp.zeros((self.batch_slots,), jnp.int32))
         return self
 
+    def _warmup_paged(self, buckets):
+        logits = None
+        for b in (buckets or [self.buckets[0]]):
+            n = blocks_for(b, self.block_size)
+            if n > self._alloc.capacity:
+                # a bucket bigger than the whole pool is unadmittable
+                # (add_request guard) — nothing will ever run it, so
+                # there is nothing to warm
+                continue
+            blocks = self._alloc.alloc(n)
+            assert blocks is not None, "warmup needs an empty pool"
+            row = np.zeros(self.blocks_per_slot, np.int32)
+            row[:n] = blocks
+            ids = jnp.zeros((1, b), jnp.int32)
+            logits, cache = self._timed(
+                "prefill_ms", ("prefill_paged", b),
+                lambda: self._prefill_paged_cold_jit(
+                    self.params, self.cache, ids, jnp.asarray(row),
+                    np.int32(1)))
+            self.cache = cache
+            if self._prefix is not None:
+                logits, cache = self._timed(
+                    "prefill_ms", ("prefill_paged_ext", b),
+                    lambda: self._prefill_paged_ext_jit(
+                        self.params, self.cache, ids, jnp.asarray(row),
+                        np.int32(0), np.int32(1)))
+                self.cache = cache
+            self._alloc.decref(blocks)
+        if logits is not None:
+            self._key, sub = jax.random.split(self._key)
+            self._timed("prefill_ms", ("sample", 1),
+                        lambda: self._sample_jit(
+                            logits, sub, jnp.zeros((1,), jnp.float32),
+                            jnp.ones((1,), jnp.float32)))
+        # decode over all-null tables: every write lands in the null
+        # block, every slot length is 0 — pure compile fodder
+        nxt, self._key, cache = self._timed(
+            "decode_ms", ("decode", 0), lambda: self._decode_paged_jit(
+                self.params, self.cache,
+                jnp.zeros(self.batch_slots, jnp.int32),
+                jnp.asarray(self._tables),
+                jnp.zeros(self.batch_slots, jnp.int32), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        self.cache = cache
+        return self
+
     @property
     def stats(self) -> dict:
         """Cumulative serving stats (SpmdTrainer.stats convention):
         prefill/decode wall-clock, compile_ms_cold (first call per
         executable), host sync time, tokens/sec over decode wall-clock,
-        mean slot occupancy, and the process-wide XLA compile/trace
-        deltas since engine construction."""
+        mean slot occupancy, the process-wide XLA compile/trace deltas
+        since engine construction — plus, paged, block-pool occupancy,
+        preemptions and radix-cache hit rates, and PER-REQUEST records
+        (TTFT / decode tokens/sec) the load harness consumes."""
         t = self._timings
         s = {k: (round(v, 3) if isinstance(v, float) else v)
              for k, v in t.items()}
@@ -423,4 +946,26 @@ class InferenceEngine:
         s["batch_slots"] = self.batch_slots
         s["buckets"] = list(self.buckets)
         s["donate"] = self._donate
+        s["kv_layout"] = self.kv_layout
+        if self.kv_layout == "paged":
+            s["kv_block_size"] = self.block_size
+            s["kv_blocks_total"] = self._alloc.capacity
+            s["kv_blocks_in_use"] = self._alloc.num_in_use
+            s["block_occupancy"] = round(
+                t["block_occupancy_sum"] / steps, 4)
+            if self._prefix is not None:
+                s.update(self._prefix.stats)
+            s.pop("block_occupancy_sum", None)    # internal accumulator
+        else:
+            s.pop("block_occupancy_sum", None)
+            s.pop("preemptions", None)
+            s.pop("memory_capped_retirements", None)
+        # per-request latency records, not just aggregates (satellite:
+        # the load harness computes its percentiles from these)
+        s["per_request"] = dict(self.request_stats)
+        ttfts = [r["ttft_ms"] for r in self.request_stats.values()]
+        if ttfts:
+            p50, p99 = np.percentile(ttfts, [50, 99])
+            s["ttft_ms_p50"] = round(float(p50), 3)
+            s["ttft_ms_p99"] = round(float(p99), 3)
         return s

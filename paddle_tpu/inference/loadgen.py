@@ -1,0 +1,179 @@
+"""Poisson-arrival serving load harness.
+
+``bench.py --serve`` measures the engine under a CLOSED loop: every
+request is enqueued up front, so the queue is always full and the only
+number that comes out is peak throughput.  Real traffic is OPEN-loop —
+requests arrive on their own clock whether or not the server keeps up —
+and the metrics that matter are the ones a user feels: time-to-first-
+token at the tail (p99), sustained tokens/sec, and how close the
+slot/block pools run to exhaustion.  This module drives the engine with
+exponential inter-arrival times (a Poisson process at ``rate_rps``) and
+reports exactly those, consuming the engine's per-request records
+(``InferenceEngine.stats['per_request']``).
+
+Workload shape: ``SharedPrefixWorkload`` mints prompts where a fraction
+share a fixed system-prompt prefix — the pattern the radix prefix cache
+exists for — so the harness also measures the prefix hit rate it buys.
+
+Everything is host-side scheduling around ``engine.step()``; the
+compile-counter discipline applies unchanged (the smoke contract:
+a whole Poisson run after warmup = ZERO new XLA compiles).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["SharedPrefixWorkload", "run_loadtest"]
+
+
+class SharedPrefixWorkload:
+    """Prompt generator: with probability ``shared_frac`` a prompt is
+    ``system_prefix + random tail``, otherwise fully random.  Tail and
+    generation lengths are uniform over the given ranges."""
+
+    def __init__(self, vocab_size: int, seed: int = 0,
+                 shared_frac: float = 0.5, prefix_len: int = 16,
+                 tail_len=(3, 12), max_new=(4, 12)):
+        self._rng = np.random.RandomState(seed)
+        self.vocab = int(vocab_size)
+        self.shared_frac = float(shared_frac)
+        self.tail_len = tail_len
+        self.max_new = max_new
+        self.system_prefix = self._rng.randint(
+            1, self.vocab, (int(prefix_len),)).astype(np.int32)
+
+    def sample(self):
+        """Returns (prompt ids, max_new_tokens)."""
+        rng = self._rng
+        tail = rng.randint(1, self.vocab, (rng.randint(
+            self.tail_len[0], self.tail_len[1] + 1),)).astype(np.int32)
+        if rng.rand() < self.shared_frac:
+            prompt = np.concatenate([self.system_prefix, tail])
+        else:
+            prompt = tail
+        return prompt, int(rng.randint(self.max_new[0],
+                                       self.max_new[1] + 1))
+
+
+def run_loadtest(engine, num_requests: int, rate_rps: float,
+                 workload: Optional[SharedPrefixWorkload] = None,
+                 seed: int = 0, eos_id: Optional[int] = None) -> dict:
+    """Open-loop Poisson load test against a warmed engine.
+
+    Arrival times are drawn up front (exponential gaps at ``rate_rps``);
+    the drive loop enqueues every request whose arrival time has passed,
+    then runs ``engine.step()`` — or, when the engine is fully idle,
+    sleeps until the next arrival (an open-loop harness must not spin
+    the decode batch on an empty engine; that would burn host time the
+    real server would spend waiting on the network).
+
+    Returns the report dict: TTFT p50/p99 (enqueue→first token, queueing
+    included — that is the point of open loop), per-request decode
+    tokens/sec p50, wall-clock tokens/sec, offered vs achieved request
+    rate, slot/block occupancy, prefix hit rate, and preemptions.
+    """
+    workload = workload or SharedPrefixWorkload(
+        getattr(engine.model.cfg, "vocab_size", 1 << 15), seed=seed)
+    # cumulative engine counters are engine-LIFETIME; snapshot so the
+    # report describes THIS window even on a reused engine (the same
+    # snapshot-and-subtract bench.py uses for compile counters)
+    t_snap = dict(engine._timings)
+    pc = engine._prefix
+    # NB: the radix cache defines __len__, so an EMPTY tree is falsy —
+    # the None-check must be identity, not truthiness
+    pc_snap = (pc.queries, pc.hit_queries, pc.hit_blocks) \
+        if pc is not None else None
+    rng = np.random.RandomState(seed + 1)
+    gaps = rng.exponential(1.0 / float(rate_rps), size=int(num_requests))
+    arrivals = np.cumsum(gaps)
+    plan = [(t,) + workload.sample() for t in arrivals]
+
+    rids: List[int] = []
+    pending = set()
+    recs = {}
+    # coordinated-omission correction: a request whose Poisson arrival
+    # passed while the harness was blocked inside a decode step is
+    # enqueued LATE — a real user's clock started at the planned
+    # arrival, so that lateness belongs in its TTFT
+    late_ms = {}
+
+    def _drain():
+        """Consume finished requests as they retire: their stat record
+        AND their result leave the engine, so neither the engine's
+        bounded per-request history (cap 4096) nor its results dict
+        truncates or accumulates over an arbitrarily long run."""
+        for r in [r for r in pending if r in engine.request_stats]:
+            rec = engine.request_stats.pop(r)
+            rec["ttft_ms"] = round(rec["ttft_ms"] + late_ms[r], 3)
+            recs[r] = rec
+            engine.results.pop(r, None)
+            pending.discard(r)
+
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(plan) or engine.has_work:
+        now = time.perf_counter() - t0
+        while i < len(plan) and plan[i][0] <= now:
+            arrival_t, prompt, max_new = plan[i]
+            rid = engine.add_request(prompt, max_new_tokens=max_new,
+                                     eos_id=eos_id)
+            late_ms[rid] = max(
+                time.perf_counter() - t0 - arrival_t, 0.0) * 1e3
+            rids.append(rid)
+            pending.add(rid)
+            i += 1
+        if engine.has_work:
+            # a wedged scheduler raises instead of busy-spinning the
+            # harness (the same stall check run()/generate() use)
+            engine.step_or_raise()
+            _drain()
+        elif i < len(plan):
+            time.sleep(min(max(plan[i][0] - now, 0.0), 0.05))
+    _drain()
+    wall_s = time.perf_counter() - t0
+
+    st = engine.stats
+    t1 = engine._timings
+    steps = max(t1["decode_steps"] - t_snap["decode_steps"], 1)
+    recs = [recs[r] for r in rids if r in recs]
+    ttfts = [r["ttft_ms"] for r in recs]
+    dtps = [r["decode_tokens_per_sec"] for r in recs
+            if r["decode_tokens_per_sec"]]
+    total_tokens = sum(r["tokens"] for r in recs)
+    report = {
+        "num_requests": len(recs),
+        "offered_rps": round(float(rate_rps), 3),
+        "achieved_rps": round(len(recs) / wall_s, 3) if wall_s else None,
+        "wall_s": round(wall_s, 3),
+        "tokens_generated": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall_s, 2)
+        if wall_s else None,
+        "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3)
+        if ttfts else None,
+        "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3)
+        if ttfts else None,
+        "decode_tokens_per_sec_p50": round(float(np.percentile(dtps, 50)),
+                                           2) if dtps else None,
+        "slot_occupancy": round(
+            (t1["occupancy_sum"] - t_snap["occupancy_sum"]) / steps, 4),
+        "preemptions": (t1["preemptions"] - t_snap["preemptions"])
+        if "preemptions" in t_snap else 0,
+        "kv_layout": st["kv_layout"],
+    }
+    for k in ("kv_block_size", "kv_blocks_total"):
+        if k in st:
+            report[k] = st[k]
+    if engine.kv_layout == "paged":
+        report["block_occupancy"] = round(
+            (t1["block_occupancy_sum"] - t_snap["block_occupancy_sum"])
+            / steps, 4)
+    if pc_snap is not None:
+        dq = pc.queries - pc_snap[0]
+        dh = pc.hit_queries - pc_snap[1]
+        report["prefix_queries"] = dq
+        report["prefix_hit_rate"] = round(dh / dq, 4) if dq else 0.0
+        report["prefix_hit_blocks"] = pc.hit_blocks - pc_snap[2]
+    return report

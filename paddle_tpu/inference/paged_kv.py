@@ -1,0 +1,175 @@
+"""Paged KV cache: a fixed block pool + per-slot block tables.
+
+The PR-4 serving engine preallocates a DENSE per-slot cache
+``[layers, batch_slots, max_seq, kv_heads, head_dim]`` — every slot
+owns ``max_seq`` positions whether it uses them or not, so slot count
+(= concurrent users) is capped by ``slots × max_seq`` memory even when
+every live request is short.  This module is the vLLM-style fix
+(Kwon et al., *Efficient Memory Management for Large Language Model
+Serving with PagedAttention*): K/V live in a pool of fixed-size blocks
+
+    ``[layers, num_blocks, block_size, kv_heads, head_dim]``
+
+and each slot holds a small BLOCK TABLE of pool indices.  A slot
+consumes exactly ``ceil(len/block_size)`` blocks, so concurrency is
+bounded by total memory, not by the worst-case sequence length — and
+blocks can be SHARED between slots (refcounts), which is what makes
+radix prefix caching (prefix_cache.py) free.
+
+Split of responsibilities, mirroring the reference framework's
+AllocatorFacade layer (PAPER.md §1 layer 1 — allocator policy lives
+outside the kernels):
+
+- **Device** (:class:`PagedKVCache`): the k/v pools only.  Statically
+  shaped; every update inside the prefill/decode executables is a
+  ``dynamic_update_slice``/scatter, so the zero-recompile invariant of
+  the dense engine survives paging.  Registered as a pytree so it rides
+  jit carries and donation.
+- **Host** (:class:`BlockAllocator`): free-list + per-block refcounts.
+  Block 0 is reserved as the NULL block — unused block-table entries
+  point at it, so the executables never see an out-of-range index;
+  whatever garbage lands there is masked by per-slot lengths.
+
+Block tables and per-slot lengths stay host-side (numpy) and enter the
+executables as ordinary ``[batch_slots, max_blocks]`` / ``[batch_slots]``
+int32 operands each step: their shapes never change, and shipping a few
+hundred int32s per step is noise next to the cache itself.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "BlockAllocator", "init_paged_cache",
+           "blocks_for"]
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` positions."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+class PagedKVCache:
+    """Device half of the paged cache: ``k``/``v`` are
+    ``[layers, num_blocks, block_size, kv_heads, head_dim]`` block
+    pools.  Which blocks belong to which slot is the host allocator's
+    business; the executables receive block tables as operands."""
+
+    __slots__ = ("k", "v")
+
+    def __init__(self, k, v):
+        self.k, self.v = k, v
+
+    @property
+    def num_layers(self):
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self):
+        return self.k.shape[1]
+
+    @property
+    def block_size(self):
+        return self.k.shape[2]
+
+    def __repr__(self):
+        return (f"PagedKVCache(layers={self.k.shape[0]}, "
+                f"blocks={self.k.shape[1]}, block_size={self.k.shape[2]}, "
+                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype})")
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache,
+    lambda c: ((c.k, c.v), None),
+    lambda aux, ch: PagedKVCache(*ch))
+
+
+def init_paged_cache(model, num_blocks: int, block_size: int,
+                     dtype=None) -> PagedKVCache:
+    """Allocate the zeroed block pool for ``model`` (a GPTForCausalLM /
+    GPTModel).  ``num_blocks`` INCLUDES the reserved null block 0, so
+    the usable capacity is ``num_blocks - 1`` blocks."""
+    gpt = getattr(model, "gpt", model)
+    cfg = gpt.cfg
+    dt = dtype or gpt.wte.weight.dtype
+    shape = (cfg.num_layers, int(num_blocks), int(block_size),
+             cfg.num_kv_heads, cfg.head_dim)
+    return PagedKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+
+
+class BlockAllocator:
+    """Host-side pool bookkeeping: LIFO free-list + refcounts.
+
+    Block ids run ``1..num_blocks-1`` (0 is the null block and is never
+    handed out).  ``alloc`` refuses rather than over-commits — the
+    scheduler turns a refusal into queueing/eviction/preemption, which
+    is the whole point of admission-by-free-blocks.  ``incref`` is how
+    a second owner (another slot sharing a prefix, or the radix cache
+    pinning a node) holds a block; ``decref`` frees at zero.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the "
+                             "reserved null block)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._refs = np.zeros(self.num_blocks, np.int32)
+        # LIFO: recently-freed blocks are re-used first (their pool rows
+        # are warm in cache on CPU; harmless on TPU)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (null block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return int(self._refs[block])
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks at refcount 1, or None when the pool cannot
+        satisfy the request (caller queues/evicts/preempts)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def incref(self, blocks) -> None:
+        for b in blocks:
+            if self._refs[b] <= 0:
+                raise RuntimeError(f"incref on free block {b}")
+            self._refs[b] += 1
+
+    def decref(self, blocks) -> None:
+        for b in blocks:
+            r = int(self._refs[b]) - 1
+            if r < 0:
+                raise RuntimeError(f"double free of block {b}")
+            self._refs[b] = r
+            if r == 0:
+                self._free.append(b)
+
+    def check_leak_free(self) -> None:
+        """Raise unless every block is back on the free list — the
+        drain invariant the load-test smoke asserts."""
+        if self.num_free != self.capacity:
+            held = [b for b in range(1, self.num_blocks)
+                    if self._refs[b] > 0]
+            raise AssertionError(
+                f"block pool leak: {self.num_free}/{self.capacity} free; "
+                f"held blocks {held[:16]}{'...' if len(held) > 16 else ''}")

@@ -1,0 +1,210 @@
+"""Radix prefix cache: shared system prompts prefill once.
+
+SGLang's observation (Zheng et al., *Efficiently Programming Large
+Language Models using SGLang*): serving traffic is dominated by
+requests sharing long prompt prefixes (system prompts, few-shot
+preambles, chat history), so the KV cache of those prefixes should be
+COMPUTED ONCE and shared — which the paged layout makes trivial,
+because sharing a prefix is just pointing two block tables at the same
+pool blocks and bumping refcounts.
+
+The tree here is a radix tree over token sequences at BLOCK
+granularity: each node owns exactly one pool block and is keyed by that
+block's ``block_size``-token chunk (a fixed-width edge label — the
+radix compression unit is the KV block, since sub-block sharing cannot
+be expressed in a block table anyway).  Matching a new prompt walks the
+tree chunk by chunk; every matched node's block goes straight into the
+request's block table and its refcount is bumped, so prefill runs only
+over the DIVERGENT SUFFIX.  Because matching stops at the first
+non-equal chunk, a diverging request simply gets fresh blocks for its
+suffix — copy-on-write at block granularity falls out of never handing
+out writable references to shared blocks.
+
+Lifetime: the tree itself holds one reference on every node's block
+(allocator refcount), independent of any slot.  When the pool runs dry
+the scheduler calls :meth:`evict`, which walks leaves in LRU order and
+frees only blocks nobody else references — blocks pinned by an active
+slot are skipped (their node stays so the slot's retirement returns
+them to a still-cached state).  ``PADDLE_TPU_PREFIX_CACHE=0`` disables
+the whole thing (the engine then never constructs one).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["RadixPrefixCache"]
+
+
+class _Node:
+    __slots__ = ("key", "block", "children", "parent", "last_used")
+
+    def __init__(self, key: Optional[tuple], block: Optional[int],
+                 parent: Optional["_Node"]):
+        self.key = key            # block_size-token tuple (None at root)
+        self.block = block        # pool block id (None at root)
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Block-granular radix tree over token prefixes.
+
+    The cache owns one allocator reference per node; `match` hands the
+    caller block ids to alias into a slot's table (the caller increfs
+    them for the slot's own lifetime), `insert` adopts a freshly
+    prefilled slot's blocks into the tree, `evict` trims LRU leaves
+    whose blocks nobody else holds.
+    """
+
+    def __init__(self, allocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = int(block_size)
+        self._root = _Node(None, None, None)
+        self._nodes = 0
+        self._clock = itertools.count(1)
+        # stats the engine/load harness report
+        self.queries = 0
+        self.hit_queries = 0
+        self.hit_blocks = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+
+    def __len__(self):
+        return self._nodes
+
+    @property
+    def cached_blocks(self) -> int:
+        return self._nodes
+
+    # ---- lookup -------------------------------------------------------
+    def _chunks(self, tokens) -> List[tuple]:
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        return [tuple(toks[i:i + bs])
+                for i in range(0, len(toks) - bs + 1, bs)]
+
+    def match(self, tokens) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens``: returns (blocks,
+        matched_token_count).  Only FULL blocks match, and at least one
+        token is always left for the caller to prefill (a prefill must
+        see >= 1 real token to produce next-token logits), so the match
+        is capped at ``len(tokens) - 1`` rounded down to a block
+        boundary.  Touches the matched path's LRU clocks."""
+        self.queries += 1
+        usable = (len(tokens) - 1) // self.block_size
+        blocks: List[int] = []
+        node = self._root
+        tick = next(self._clock)
+        for chunk in self._chunks(tokens)[:usable]:
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_used = tick
+            blocks.append(child.block)
+            node = child
+        if blocks:
+            self.hit_queries += 1
+            self.hit_blocks += len(blocks)
+        return blocks, len(blocks) * self.block_size
+
+    # ---- insertion ----------------------------------------------------
+    def insert(self, tokens, blocks: List[int]) -> int:
+        """Register a prefilled prompt: ``blocks[i]`` holds tokens
+        ``[i*bs, (i+1)*bs)``.  Existing nodes win (a concurrent
+        identical prompt admitted cold keeps the FIRST copy; the
+        duplicate blocks stay slot-owned and retire normally).  New
+        nodes incref their block — the tree's own reference.  Returns
+        the number of newly adopted blocks."""
+        node = self._root
+        adopted = 0
+        tick = next(self._clock)
+        for chunk, block in zip(self._chunks(tokens), blocks):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, int(block), node)
+                node.children[chunk] = child
+                self._alloc.incref([int(block)])
+                self._nodes += 1
+                adopted += 1
+            child.last_used = tick
+            node = child
+        self.inserted_blocks += adopted
+        return adopted
+
+    # ---- eviction -----------------------------------------------------
+    def _leaves(self) -> List[_Node]:
+        out, stack = [], [self._root]
+        while stack:
+            n = stack.pop()
+            if n is not self._root and not n.children:
+                out.append(n)
+            stack.extend(n.children.values())
+        return out
+
+    def _drop(self, node: _Node) -> None:
+        node.parent.children.pop(node.key, None)
+        self._alloc.decref([node.block])
+        self._nodes -= 1
+        self.evicted_blocks += 1
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` pool blocks by dropping LRU leaves
+        whose block only the tree references (refcount 1 — a block a
+        live slot still uses is pinned).  Dropping a leaf may expose
+        its parent as the next LRU leaf, so parents are PROMOTED into
+        the candidate heap as their last child falls — one tree walk
+        per call, not one per freed block (eviction sits on the
+        admission hot path).  Returns blocks freed."""
+        import heapq
+        heap = [(lf.last_used, id(lf), lf) for lf in self._leaves()]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_blocks:
+            _, _, node = heapq.heappop(heap)
+            # re-check at pop time: the node must still be an attached
+            # leaf (heap entries can go stale as the tree mutates) and
+            # unpinned (refcounts don't change within a call, so a
+            # skipped pinned leaf stays out for good)
+            if node.children or \
+                    node.parent.children.get(node.key) is not node:
+                continue
+            if self._alloc.refcount(node.block) != 1:
+                continue                       # pinned by a live slot
+            parent = node.parent
+            self._drop(node)
+            freed += 1
+            if parent is not self._root and not parent.children:
+                heapq.heappush(heap,
+                               (parent.last_used, id(parent), parent))
+        return freed
+
+    def flush(self) -> int:
+        """Drop EVERY node, releasing the tree's references (blocks a
+        slot still uses survive under the slot's own reference).  The
+        drain/leak accounting path."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        order: List[_Node] = []
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        for n in reversed(order):          # leaves before parents
+            self._drop(n)
+            dropped += 1
+        return dropped
+
+    # ---- stats --------------------------------------------------------
+    @property
+    def stats(self) -> dict:
+        return {
+            "prefix_queries": self.queries,
+            "prefix_hit_queries": self.hit_queries,
+            "prefix_hit_rate": round(self.hit_queries / self.queries, 4)
+            if self.queries else 0.0,
+            "prefix_hit_blocks": self.hit_blocks,
+            "prefix_cached_blocks": self._nodes,
+            "prefix_evicted_blocks": self.evicted_blocks,
+        }

@@ -382,6 +382,77 @@ class GPTAttention(Layer):
         out = out[:, None].astype(q.dtype)               # [b, 1, H, D]
         return self._proj_out(out, b, 1), k_layer, v_layer
 
+    def forward_prefill_paged(self, x, k_buf, v_buf, prefix_len):
+        """Prefill attention over ONE slot's gathered block buffer:
+        ``k_buf``/``v_buf`` are the slot's blocks laid out contiguously
+        ``[cap_row, Hkv, D]`` (cap_row = max_blocks·block_size) with
+        ``prefix_len`` tokens already valid (a radix-cache hit; 0 =
+        cold).  Writes the s new k/v at ``prefix_len`` and attends
+        suffix query i (absolute position prefix_len+i) against buffer
+        keys ``j <= prefix_len + i``.
+
+        ``prefix_len`` may be a PYTHON INT 0 — the engine compiles that
+        as its own executable so the cold path keeps the exact
+        ring/flash/composite attention of the dense prefill (bitwise
+        parity with the dense engine); a traced prefix_len takes the
+        masked composite over the whole buffer.  Returns
+        ``(out, k_buf, v_buf)``."""
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        static_cold = isinstance(prefix_len, int) and prefix_len == 0
+        off = jnp.asarray(prefix_len, jnp.int32)
+        zero = jnp.asarray(0, jnp.int32)
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, k[0].astype(k_buf.dtype), (off, zero, zero))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, v[0].astype(v_buf.dtype), (off, zero, zero))
+        if static_cold:
+            out = self._attend_fresh(q, k, v, b, s)
+        else:
+            cap = k_buf.shape[0]
+            kf, vf = k_buf[None], v_buf[None]       # [1, cap, Hkv, D]
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                kf = jnp.repeat(kf, rep, axis=2)
+                vf = jnp.repeat(vf, rep, axis=2)
+            # query i sees buffer keys j <= prefix_len + i
+            mask = (jnp.arange(cap)[None, :] <=
+                    (off + jnp.arange(s))[:, None])
+            out = F.scaled_dot_product_attention(
+                Tensor(q), Tensor(kf.astype(q.dtype)),
+                Tensor(vf.astype(q.dtype)),
+                attn_mask=mask[None, None], training=False).data
+        return self._proj_out(out, b, s), k_buf, v_buf
+
+    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths):
+        """One decode step over a PagedKVCache layer: write each slot's
+        new k/v at pool position ``(tables[b, lengths[b]//bs],
+        lengths[b]%bs)`` (scatter), then run the paged fused attention
+        streaming the slot's blocks through its table.  x [B, 1, H];
+        k_pool/v_pool [num_blocks, bs, Hkv, D]; tables [B, MB] int32;
+        lengths [B] int32 EXCLUDING the new token.  Inactive slots write
+        into the reserved null block (their table rows are all-zero) —
+        masked garbage by construction.  Returns
+        ``(out, k_pool, v_pool)``."""
+        b = x.shape[0]
+        bs = k_pool.shape[1]
+        mb = tables.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        lens = lengths.astype(jnp.int32)
+        blk_pos = jnp.minimum(lens // bs, mb - 1)
+        off = lens % bs
+        rows = jnp.arange(b)
+        blk = tables[rows, blk_pos]
+        k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
+        from .. import ops as _ops
+        out = _ops.paged_decode_attention(
+            q[:, 0].astype(k_pool.dtype), k_pool, v_pool, tables,
+            lens + 1)
+        out = out[:, None].astype(q.dtype)               # [b, 1, H, D]
+        return self._proj_out(out, b, 1), k_pool, v_pool
+
     def forward(self, x, attn_mask=None, cache=None):
         cfg = self.cfg
         b = x.shape[0]
@@ -513,6 +584,22 @@ class GPTBlock(Layer):
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, k_layer, v_layer
+
+    def forward_prefill_paged(self, x, k_buf, v_buf, prefix_len):
+        """Block prefill over one slot's gathered block buffer."""
+        a, k_buf, v_buf = self.attn.forward_prefill_paged(
+            self.ln_1(x), k_buf, v_buf, prefix_len)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_buf, v_buf
+
+    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths):
+        """Single-token block step over one PagedKVCache layer."""
+        a, k_pool, v_pool = self.attn.forward_decode_paged(
+            self.ln_1(x), k_pool, v_pool, tables, lengths)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_pool, v_pool
 
 
 class GPTModel(Layer):
@@ -736,6 +823,73 @@ class GPTModel(Layer):
             cache.capacity)
         return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths)
 
+    # ---- serving path: paged KV cache ---------------------------------
+    def forward_prefill_paged(self, input_ids, cache, table_row,
+                              prefix_len):
+        """Prefill ONE slot over a PAGED cache: ``input_ids [1, s]`` is
+        the (bucket-padded) DIVERGENT SUFFIX — tokens ``prefix_len`` of
+        the prompt onward; ``table_row [max_blocks]`` int32 maps the
+        slot's positions to pool blocks (shared radix-cache blocks for
+        the prefix, fresh blocks for the suffix, null block 0 beyond).
+        Per layer: gather the slot's blocks contiguous, write the suffix
+        k/v at ``prefix_len``, attend, scatter the blocks back.  Pool
+        shapes never change, so one executable serves any prefix length
+        (``prefix_len`` rides in as a traced scalar; the engine compiles
+        the common cold case — a static Python 0 — separately to keep
+        the dense prefill's exact attention path).  Returns
+        ``(hidden [1, s, H], cache)``."""
+        ids = input_ids.data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        cfg = self.cfg
+        s = ids.shape[1]
+        mb = table_row.shape[0]
+        bs = cache.block_size
+        hkv, dh = cfg.num_kv_heads, cfg.head_dim
+        off = jnp.asarray(prefix_len, jnp.int32)
+        pos = jnp.minimum(off + jnp.arange(s, dtype=jnp.int32),
+                          cfg.max_seq_len - 1)
+        x = self.wte(Tensor(ids)) + self.wpe(Tensor(pos[None, :]))
+        x = self.drop(x)
+        table_row = jnp.asarray(table_row, jnp.int32)
+        cache_k, cache_v = cache.k, cache.v
+        for i, blk in enumerate(self.blocks):
+            k_buf = cache_k[i][table_row].reshape(mb * bs, hkv, dh)
+            v_buf = cache_v[i][table_row].reshape(mb * bs, hkv, dh)
+            x, k_buf, v_buf = blk.forward_prefill_paged(
+                x, k_buf, v_buf, prefix_len)
+            # duplicate table entries (trailing null-block slots) scatter
+            # identical gathered-back values — benign by construction
+            cache_k = cache_k.at[i, table_row].set(
+                k_buf.reshape(mb, bs, hkv, dh))
+            cache_v = cache_v.at[i, table_row].set(
+                v_buf.reshape(mb, bs, hkv, dh))
+        return self.ln_f(x), type(cache)(cache_k, cache_v)
+
+    def forward_decode_paged(self, tokens, cache, tables, lengths):
+        """One decode step for every slot over the PAGED cache: append
+        ``tokens [B]`` at each slot's ``lengths[b]`` through its block
+        table, run the paged fused attention per layer.  Lengths are
+        HOST state with the paged layout (the scheduler owns block
+        accounting), so they ride in as an operand and are not advanced
+        in-graph.  Returns ``(hidden [B, 1, H], cache)``."""
+        cfg = self.cfg
+        tables = jnp.asarray(tables, jnp.int32)
+        b = tables.shape[0]
+        toks = tokens.data if isinstance(tokens, Tensor) \
+            else jnp.asarray(tokens)
+        lens = jnp.asarray(lengths, jnp.int32)
+        pos = jnp.minimum(lens, cfg.max_seq_len - 1)
+        x = self.wte(Tensor(toks.reshape(b, 1))) + \
+            self.wpe(Tensor(pos.reshape(b, 1)))
+        x = self.drop(x)
+        cache_k, cache_v = cache.k, cache.v
+        for i, blk in enumerate(self.blocks):
+            x, k_pool, v_pool = blk.forward_decode_paged(
+                x, cache_k[i], cache_v[i], tables, lens)
+            cache_k = cache_k.at[i].set(k_pool)
+            cache_v = cache_v.at[i].set(v_pool)
+        return self.ln_f(x), type(cache)(cache_k, cache_v)
+
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.recompute import recompute as _rc
         s = input_ids.shape[1]
@@ -844,6 +998,31 @@ class GPTForCausalLM(Layer):
         logits = self._head_logits(h)                     # [B, 1, V]
         return logits.data[:, 0], cache
 
+    def prefill_paged(self, input_ids, cache, table_row, prefix_len,
+                      suffix_len):
+        """Paged prefill of one slot; ``input_ids`` is the bucket-padded
+        divergent suffix and ``suffix_len`` its real token count.
+        Returns ``(logits [1, V], cache)`` — the logits of the last real
+        suffix token (= the first generated token's distribution)."""
+        h, cache = self.gpt.forward_prefill_paged(
+            input_ids, cache, table_row, prefix_len)
+        harr = h.data                                     # [1, s, H]
+        last = jax.lax.dynamic_slice(
+            harr, (jnp.asarray(0, jnp.int32),
+                   jnp.asarray(suffix_len, jnp.int32) - 1,
+                   jnp.asarray(0, jnp.int32)),
+            (1, 1, harr.shape[-1]))[:, 0]                 # [1, H]
+        logits = self._head_logits(Tensor(last))
+        return logits.data, cache
+
+    def decode_step_paged(self, tokens, cache, tables, lengths):
+        """One paged decode step for all slots; returns
+        ``(logits [B, V], cache)``."""
+        h, cache = self.gpt.forward_decode_paged(tokens, cache, tables,
+                                                 lengths)
+        logits = self._head_logits(h)                     # [B, 1, V]
+        return logits.data[:, 0], cache
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
@@ -864,11 +1043,11 @@ class GPTForCausalLM(Layer):
             else input_ids).reshape(-1).astype(np.int32)
         eng = InferenceEngine(self, batch_slots=1,
                               top_k=top_k, seed=seed)
-        rid = eng.add_request(ids, max_new_tokens=max_new_tokens,
-                              eos_id=eos_id, temperature=temperature,
-                              top_p=top_p)
-        outs = eng.run()
-        gen = np.asarray(outs[rid], np.int32)
+        # engine.generate routes through the admission queue: on a busy
+        # engine the call BLOCKS until a slot frees instead of raising
+        gen = np.asarray(eng.generate(
+            ids, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            temperature=temperature, top_p=top_p), np.int32)
         if include_prompt:
             return np.concatenate([ids, gen])
         return gen

@@ -10,6 +10,7 @@ from .flash_attention import (  # noqa: F401
     flash_attention, flash_attention_available, get_block_sizes,
     set_interpret_mode)
 from .decode_attention import (  # noqa: F401
-    decode_attention, decode_attention_available)
+    decode_attention, decode_attention_available,
+    paged_decode_attention, paged_decode_attention_available)
 from .fused_cross_entropy import (  # noqa: F401
     fused_linear_cross_entropy, pick_vocab_block)

@@ -38,6 +38,7 @@ import importlib
 _fa = importlib.import_module(__package__ + ".flash_attention")
 
 __all__ = ["decode_attention", "decode_attention_available",
+           "paged_decode_attention", "paged_decode_attention_available",
            "set_interpret_mode"]
 
 _NEG = -1e30
@@ -173,4 +174,137 @@ def decode_attention(q, k_cache, v_cache, lengths):
     k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
     v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
     o3 = _decode_gqa(q3, k3, v3, mask.reshape(b, 1, s))
+    return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
+# paged variant: K/V live in a block pool, streamed through a block table
+# ---------------------------------------------------------------------------
+def paged_decode_attention_available() -> bool:
+    """The paged kernel additionally needs scalar prefetch (the block
+    table drives the K/V DMA addresses), so it requires the pltpu grid
+    spec — same availability surface as the dense kernel otherwise."""
+    return decode_attention_available() and _fa.pltpu is not None
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, block_size: int, hkv: int,
+                  scale: float):
+    """One (b·hkv, j) program: j walks the slot's block table; the
+    BlockSpec index_map already resolved table entry j to a pool block,
+    so k_ref/v_ref hold that block's ``[block_size, D]`` strip for this
+    kv head.  Online-softmax state (m/l/acc) persists in VMEM scratch
+    across the j steps (TPU grids run sequentially, innermost fastest);
+    the output is written once on the last block."""
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    b = pl.program_id(0) // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:]                                        # [G, D]
+    k_blk = k_ref[:]                                    # [bs, D]
+    v_blk = v_ref[:]
+    sblk = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [G, bs] f32
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    sblk = jnp.where(pos < len_ref[b], sblk, _NEG)
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+    p = jnp.exp(sblk - m_new)
+    p = jnp.where(sblk <= _NEG / 2, 0.0, p)             # fully-masked blocks
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_gqa(q3, k_pool, v_pool, tables, lengths):
+    """q3 [B·Hkv, G, D]; pools [NB, bs, Hkv, D]; tables [B, MB] int32;
+    lengths [B] int32.  Scalar-prefetched tables/lengths let each grid
+    step's index_map pick its pool block, so only the slot's own blocks
+    ever leave HBM (no gather of the whole table into dense form)."""
+    pltpu = _fa.pltpu
+    bhkv, g, d = q3.shape
+    bs = k_pool.shape[1]
+    b, mb = tables.shape
+    hkv = bhkv // b
+    scale = 1.0 / math.sqrt(d)
+    kv_spec = pl.BlockSpec(
+        (None, bs, None, d),
+        lambda i, j, tbl, lens, hkv=hkv: (tbl[i // hkv, j], 0, i % hkv, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bhkv, mb),
+        in_specs=[
+            pl.BlockSpec((None, g, d), lambda i, j, tbl, lens: (i, 0, 0)),
+            kv_spec, kv_spec,
+        ],
+        out_specs=pl.BlockSpec((None, g, d),
+                               lambda i, j, tbl, lens: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max
+            pltpu.VMEM((g, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((g, d), jnp.float32),     # output accumulator
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, block_size=bs, hkv=hkv,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bhkv, g, d), q3.dtype),
+        interpret=_interpret(),
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q3, k_pool, v_pool)
+
+
+def _paged_composite(q, k_pool, v_pool, tables, lengths):
+    """XLA reference math: gather each slot's blocks into the dense
+    ``[B, S, Hkv, D]`` layout (S = MB·bs) and reuse the dense composite.
+    Bitwise-identical to the dense path on identical cache contents —
+    the parity oracle tests/test_paged_kv.py leans on."""
+    b, mb = tables.shape
+    bs, hkv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
+    kg = k_pool[tables].reshape(b, mb * bs, hkv, d)
+    vg = v_pool[tables].reshape(b, mb * bs, hkv, d)
+    return _decode_composite(q, kg, vg, lengths)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths):
+    """Single-token attention over a PAGED, length-masked KV cache.
+
+    q ``[B, H, D]`` — the new token's query per slot; k_pool/v_pool
+    ``[num_blocks, block_size, Hkv, D]`` — the shared block pool AFTER
+    the new token's k/v were written; tables ``[B, max_blocks]`` int32 —
+    per-slot block table (pool indices; entries past the slot's extent
+    point at the reserved null block and stay masked); lengths ``[B]``
+    int32 — valid tokens per slot including the new one.  Returns
+    ``[B, H, D]``.  The Pallas kernel streams K/V block-by-block through
+    the block table via scalar prefetch; the XLA composite gathers the
+    table into dense form and is the CPU/fallback ground truth.
+    """
+    b, h, d = q.shape
+    bs, hkv = k_pool.shape[1], k_pool.shape[2]
+    supported = (bs % 128 == 0 and (d % 128 == 0 or d == 64)
+                 and h % hkv == 0)
+    if not supported or not paged_decode_attention_available():
+        return _paged_composite(q, k_pool, v_pool, tables, lengths)
+    q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
+    o3 = _paged_gqa(q3, k_pool, v_pool, tables, lengths)
     return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)

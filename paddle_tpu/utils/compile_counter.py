@@ -24,10 +24,12 @@ are monotone — bracket a region with ``snapshot()`` and subtract.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 __all__ = ["install", "xla_compile_count", "xla_trace_count",
-           "compile_counts", "CompileCountSnapshot", "snapshot"]
+           "compile_counts", "CompileCountSnapshot", "snapshot",
+           "assert_no_recompiles"]
 
 _lock = threading.Lock()
 _STATE = {"installed": False, "compiles": 0, "traces": 0}
@@ -101,3 +103,24 @@ class CompileCountSnapshot:
 
 def snapshot() -> CompileCountSnapshot:
     return CompileCountSnapshot()
+
+
+@contextlib.contextmanager
+def assert_no_recompiles(what: str = "region", traces: bool = True):
+    """Bracket a region that MUST be recompile-free (a warmed decode
+    loop, a Poisson load-test window): raises AssertionError on exit if
+    any XLA backend compile — or, with ``traces=True``, any jaxpr trace
+    (which catches shape wobbles a warm on-disk cache would hide) —
+    happened inside.  The assertion form of the snapshot()/subtract
+    idiom, so tests and the serving smokes share one spelling."""
+    snap = snapshot()
+    yield snap
+    if snap.new_compiles:
+        raise AssertionError(
+            f"{snap.new_compiles} XLA compile(s) inside {what} "
+            f"(expected 0 — a shape or dtype wobbled)")
+    if traces and snap.new_traces:
+        raise AssertionError(
+            f"{snap.new_traces} jaxpr trace(s) inside {what} "
+            f"(expected 0 — something re-traced even if the backend "
+            f"compile was cached)")

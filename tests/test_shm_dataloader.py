@@ -147,8 +147,12 @@ def _collect_plain(loader):
     return [np.asarray(b.data) for b in loader]
 
 
+@pytest.mark.slow
 def test_multiprocess_loader_transform_heavy():
-    """Transforms run in the worker PROCESS (CPU parallel, no GIL)."""
+    """Transforms run in the worker PROCESS (CPU parallel, no GIL).
+    Throughput-flavored soak (heavy per-sample matmuls across worker
+    restarts); slow-marked — multiprocess CORRECTNESS stays tier-1 via
+    test_multiprocess_loader_matches_single / dead-worker tests."""
     class Heavy:
         def __len__(self):
             return 16
