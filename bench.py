@@ -22,6 +22,29 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _persist_row(row, kind="train"):
+    """Append one measured row to the incremental JSON log AS MEASURED
+    (fsync'd append): a transient remote-compile HTTP-500 late in a
+    sweep no longer loses the rows already paid for — r04 and half of
+    r05 died with every row still in memory.  BENCH_ROWS_FILE names the
+    file ('0'/'off' disables; default BENCH_rows.jsonl next to this
+    script)."""
+    path = os.environ.get("BENCH_ROWS_FILE", "").strip()
+    if path.lower() in ("0", "off", "none", "false"):
+        return
+    if not path:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_rows.jsonl")
+    try:
+        rec = {"kind": kind, "ts": time.time(), **row}
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except (OSError, TypeError, ValueError) as e:
+        log(f"  row persist skipped: {type(e).__name__}: {e}")
+
+
 # peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
 # NB: v5e's headline 394 TFLOPS is the INT8 number; bf16 peak is 197.
 _PEAK_BF16 = {
@@ -71,11 +94,19 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     # kill-switches for A/B
     fused_ce = os.environ.get("BENCH_FUSED_CE", "1") != "0"
     scan_layers = os.environ.get("BENCH_SCAN_LAYERS", "1") != "0"
+    # AQT fake-quant matmuls (BENCH_QUANTIZE=int8|fp8): quantized
+    # forward + straight-through backward — the int8 MXU runs at 2× the
+    # bf16 rate, the direct attack on ROADMAP item 1's 35%→45% gap.
+    # MFU stays reported against the bf16 peak so the trajectory rows
+    # compare like for like.
+    quantize = os.environ.get("BENCH_QUANTIZE", "").strip().lower()
+    quantize = None if quantize in ("", "0", "off", "none") else quantize
     cfg = replace(gpt_configs()[config_name], max_seq_len=seq,
-                  use_flash_attention=use_flash, fused_ce=fused_ce)
+                  use_flash_attention=use_flash, fused_ce=fused_ce,
+                  quantize=quantize)
     log(f"bench: {config_name} seq={seq} batch={batch} "
         f"flash={use_flash} fused_ce={fused_ce} scan={scan_layers} "
-        f"({cfg.num_params()/1e6:.0f}M params)")
+        f"quantize={quantize} ({cfg.num_params()/1e6:.0f}M params)")
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -197,7 +228,7 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
     flops_tok = cfg.flops_per_token(seq)
     peak = peak_flops(jax.devices()[0])
     mfu = tokens_per_sec * flops_tok / peak if peak else 0.0
-    return {
+    row = {
         "config": config_name, "batch": batch, "seq": seq,
         "steps": steps, "step_ms": round(step_ms, 2),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
@@ -208,6 +239,10 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
         "flash_kernel_in_step": flash_in_step,
         "fused_ce": fused_ce,
         "scan_layers": scan_layers,
+        # quantized-path knobs (ISSUE 7): the next TPU run must be able
+        # to attribute its MFU delta to these
+        "quantize": quantize,
+        "kv_dtype": os.environ.get("PADDLE_TPU_KV_DTYPE") or None,
         # the autotuned tiles this step's flash kernel ran with
         "flash_blocks": list(_flash_blocks(
             seq, cfg.hidden_size // cfg.num_heads)) if use_flash else None,
@@ -231,6 +266,8 @@ def bench_train(config_name, batch, seq, steps, warmup, use_flash=True,
             "comm_ms", "comm_fraction", "comm_bytes",
             "comm_collectives")},
     }
+    _persist_row(row, kind="train")
+    return row
 
 
 def _transient_compile_error(e) -> bool:
@@ -428,6 +465,7 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         "unit": "tok/s",
         "config": config_name,
         "batch_slots": batch_slots,
+        "kv_dtype": eng.kv_dtype or "dense",
         "prompt_len": prompt_len,
         "prefill_bucket": bucket,
         "gen_tokens": gen_tokens,
@@ -481,6 +519,7 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         out["ok"] = True
         log(f"  serve smoke ok: {total_tokens} tokens, 0 compiles, "
             f"{syncs} syncs/{budget} budget")
+    _persist_row(out, kind="serve")
     print(json.dumps(out))
 
 
@@ -561,6 +600,7 @@ def bench_loadtest(smoke=False):
         "unit": "tok/s",
         "config": config_name,
         "batch_slots": slots,
+        "kv_dtype": eng.kv_dtype or "dense",
         **report,
         "decode_steps": st["decode_steps"],
         "xla_compiles_measured": snap.new_compiles,
@@ -606,6 +646,7 @@ def bench_loadtest(smoke=False):
             f"0 compiles, pool drained "
             f"{eng._alloc.num_free}/{eng._alloc.capacity} free, "
             f"hit rate {report['prefix_hit_rate']}")
+    _persist_row(out, kind="loadtest")
     print(json.dumps(out))
 
 
@@ -662,12 +703,68 @@ def bench_multichip_smoke(n_devices=8):
         raise SystemExit(rc)
 
 
+def _smoke_quantized_decode():
+    """Quantized-path leg of --smoke (ISSUE 7): one int8-KV decode step
+    must stay within tolerance of the dense-cache logits, and a warmed
+    int8 engine must decode with ZERO new XLA compiles (the int8 cache
+    adds scale operands — this proves they are shape-stable)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.utils import compile_counter
+
+    cfg = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64,
+                    use_flash_attention=False)
+    paddle.seed(0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 97, (1, 9)).astype(np.int32)
+
+    # parity leg: prefill + one decode step, int8 cache vs fp cache
+    tok = jnp.asarray([ids[0, -1]], jnp.int32)
+    act = jnp.ones((1,), jnp.int32)
+    cf = m.init_kv_cache(1)
+    _, cf = m.prefill(jnp.asarray(ids[:, :-1]), cf, 0, 8)
+    lf, _ = m.decode_step(tok, cf, act)
+    cq = m.init_kv_cache(1, kv_dtype="int8")
+    _, cq = m.prefill(jnp.asarray(ids[:, :-1]), cq, 0, 8)
+    lq, _ = m.decode_step(tok, cq, act)
+    diff = float(np.max(np.abs(np.asarray(lq) - np.asarray(lf))))
+    scale = float(np.max(np.abs(np.asarray(lf)))) or 1.0
+    if diff > 0.05 * scale:
+        raise SystemExit(
+            f"bench --smoke: int8 KV decode diverged from the dense "
+            f"cache (max abs diff {diff:.5f} vs logit scale {scale:.4f})")
+
+    # zero-recompile leg: a warmed int8 engine generates compile-free
+    eng = InferenceEngine(m, batch_slots=2, prefill_buckets=[16],
+                          kv_dtype="int8")
+    eng.warmup(buckets=[16])
+    with compile_counter.assert_no_recompiles("quantized decode smoke"):
+        rid = eng.add_request(ids[0, :7], max_new_tokens=8)
+        gen = eng.run()[rid]
+    if len(gen) < 8:
+        raise SystemExit("bench --smoke: quantized decode produced "
+                         f"{len(gen)} tokens (expected 8)")
+    log(f"  quantized smoke ok: int8 decode diff {diff:.5f} "
+        f"(scale {scale:.3f}), {len(gen)} tokens, 0 compiles")
+    return {"quantized_decode_ok": True,
+            "quantized_logit_diff": round(diff, 5),
+            "quantized_kv_dtype": "int8"}
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
     exist and that the measured loop performed NO per-step host sync
     (the one allowed sync is the final barrier), then re-runs the same
-    tiny config to measure the persistent-cache warm start.  Exits
+    tiny config to measure the persistent-cache warm start, and finally
+    runs the quantized-decode leg (_smoke_quantized_decode: int8 KV
+    parity within tolerance + zero recompiles after warmup).  Exits
     non-zero on any violated invariant, so CI catches dispatch-path
     regressions before a TPU bench ever runs."""
     required = ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
@@ -687,16 +784,19 @@ def bench_smoke():
     # objects, so its first-call cost shows the compile-cache warm path
     warm = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
                        use_flash=False, remat=False, smoke=True)
+    qrow = _smoke_quantized_decode()
     out = {
         "metric": "bench_smoke", "ok": True,
         "compile_ms_cold": cold["compile_ms_cold"],
         "compile_ms_warm": warm["compile_ms_cold"],
         "compile_cache_dir": cold["compile_cache_dir"],
         **{k: cold[k] for k in required},
+        **qrow,
     }
     log(f"  smoke ok: cold compile {cold['compile_ms_cold']:.0f}ms, "
         f"warm {warm['compile_ms_cold']:.0f}ms, "
         f"syncs {cold['host_syncs_measured']}")
+    _persist_row(out, kind="smoke")
     print(json.dumps(out))
 
 

@@ -25,6 +25,11 @@ _DEFAULTS: Dict[str, Any] = {
     },
     "recompute": False,
     "recompute_configs": {"checkpoints": [], "policy": "dots"},
+    # AQT-style quantization-aware training: route the model's block
+    # matmuls through the int8/fp8 fake-quant path (quantized forward,
+    # straight-through backward; models expose enable_quantize())
+    "qat": False,
+    "qat_configs": {"quantize": "int8"},
     "sharding": False,
     "sharding_configs": {"sharding_group_size": 8, "stage": 2,
                          "hybrid_dp": False, "fuse_broadcast_MB": 32.0},

@@ -52,6 +52,8 @@ def pipeline_schedule_default() -> str:
 def moe_a2a_chunks(tokens: int) -> int:
     """Chunk count for the MoE shard_map all-to-all when the layer was
     built with ``a2a_chunks=None``: PADDLE_TPU_MOE_A2A_CHUNKS if set,
+    else the unified tuning table (utils.tuning, op "moe_a2a_chunks",
+    key (device_kind, tokens) — recorded by a sweep or an operator),
     else 2 (so chunk j's exchange can overlap chunk j-1's expert FFN).
     PADDLE_TPU_OVERLAP=0 forces 1 (monolithic) EVEN IF the chunk env
     var is set — the kill switch must win over every env-selected
@@ -62,7 +64,17 @@ def moe_a2a_chunks(tokens: int) -> int:
     recompile-free contract."""
     if not overlap_enabled():
         return 1
-    want = int(os.environ.get("PADDLE_TPU_MOE_A2A_CHUNKS", "0")) or 2
+    want = int(os.environ.get("PADDLE_TPU_MOE_A2A_CHUNKS", "0"))
+    if not want:
+        try:
+            from ..utils import tuning as _tuning
+            tuned = _tuning.lookup("moe_a2a_chunks",
+                                   (_tuning.device_kind(), tokens))
+            if tuned is not None:
+                want = int(tuned)
+        except (ValueError, TypeError):
+            pass
+    want = want or 2
     want = max(1, min(want, tokens if tokens > 0 else 1))
     while tokens % want:
         want -= 1

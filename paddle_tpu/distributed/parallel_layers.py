@@ -50,19 +50,38 @@ def _in_shard_map(axis_name) -> bool:
         return False
 
 
+def _quantized_linear(x, weight, bias, mode: str):
+    """x @ W (+ b) through ops.fake_quant_matmul: quantized forward
+    (int8/fp8 per-channel amax scaling), straight-through backward —
+    the AQT training path.  The bias rides full precision."""
+    from ..ops.quantized_matmul import fake_quant_matmul
+
+    def fn(a, w, *b):
+        y = fake_quant_matmul(a, w, mode)
+        return y + b[0] if b else y
+
+    if bias is None:
+        return apply(fn, x, weight, name="quantized_linear")
+    return apply(fn, x, weight, bias, name="quantized_linear")
+
+
 class ColumnParallelLinear(Layer):
     """Y = X @ W with W sharded on columns (out_features). Output is
     either gathered (gather_output=True, reference default in split) or
-    left sharded for a following RowParallelLinear."""
+    left sharded for a following RowParallelLinear.  ``quantize=
+    'int8'/'fp8'`` swaps the matmul for the fake-quant AQT path
+    (quantized forward, straight-through backward); None keeps the
+    exact unquantized lowering."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, bias_attr=None, gather_output=True,
-                 axis_name="tp", name=None):
+                 axis_name="tp", quantize=None, name=None):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
         self.gather_output = gather_output
         self.axis_name = axis_name
+        self.quantize = quantize
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
@@ -74,7 +93,10 @@ class ColumnParallelLinear(Layer):
             mark_sharding(self.bias, PartitionSpec(axis_name))
 
     def forward(self, x):
-        y = F.linear(x, self.weight, self.bias)
+        if self.quantize:
+            y = _quantized_linear(x, self.weight, self.bias, self.quantize)
+        else:
+            y = F.linear(x, self.weight, self.bias)
         if self.gather_output and _in_shard_map(self.axis_name):
             name = self.axis_name
             from . import mesh as _mesh
@@ -90,12 +112,13 @@ class RowParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, bias_attr=None, input_is_parallel=True,
-                 axis_name="tp", name=None):
+                 axis_name="tp", quantize=None, name=None):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
         self.input_is_parallel = input_is_parallel
         self.axis_name = axis_name
+        self.quantize = quantize
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
@@ -108,7 +131,10 @@ class RowParallelLinear(Layer):
             mark_sharding(self.bias, PartitionSpec(None))
 
     def forward(self, x):
-        y = F.linear(x, self.weight, None)
+        if self.quantize:
+            y = _quantized_linear(x, self.weight, None, self.quantize)
+        else:
+            y = F.linear(x, self.weight, None)
         if _in_shard_map(self.axis_name):
             name = self.axis_name
             y = apply(lambda a: jax.lax.psum(a, name), y,

@@ -168,6 +168,7 @@ class SpmdTrainer:
         # not a hand-picked subset (silent flags are worse than errors)
         supported = {
             "amp", "recompute", "sharding", "gradient_merge",
+            "qat",                      # fake-quant matmuls (see below)
             "tensor_parallel",          # honored via param.pspec + mesh
             "find_unused_parameters",   # moot: XLA zero-grads unused params
             "fuse_all_reduce_ops",      # moot: XLA fuses collectives
@@ -285,6 +286,19 @@ class SpmdTrainer:
                 model.enable_recompute(policy=pol)
             else:
                 model.enable_recompute()
+
+        # quantization-aware training (strategy.qat): every block linear
+        # runs the int8/fp8 fake-quant matmul (quantized forward,
+        # straight-through backward — ops.quantized_matmul).  One knob:
+        # qat_configs={'quantize': 'int8'|'fp8'}.  Params/optimizer are
+        # untouched, so every other strategy flag composes.
+        if st.qat:
+            if not hasattr(model, "enable_quantize"):
+                raise NotImplementedError(
+                    "strategy.qat=True but the model has no "
+                    "enable_quantize(); route its matmuls through "
+                    "paddle_tpu.ops.fake_quant_matmul instead")
+            model.enable_quantize(st.qat_configs.get("quantize", "int8"))
 
         # scan-over-layers (recompute_configs={'scan_layers': True}):
         # the model runs its homogeneous block stack as one lax.scan so

@@ -49,8 +49,11 @@ Knobs: ``PADDLE_TPU_DECODE_SLOTS`` (default 8),
 ``PADDLE_TPU_PREFILL_BUCKETS`` (comma-separated lengths; default powers
 of two up to max_seq_len), ``PADDLE_TPU_KV_LAYOUT`` (dense|paged),
 ``PADDLE_TPU_KV_BLOCK_SIZE`` (default 128), ``PADDLE_TPU_KV_BLOCKS``
-(usable pool blocks; default = dense-equivalent memory), and
-``PADDLE_TPU_PREFIX_CACHE`` (default on for paged).
+(usable pool blocks; default = dense-equivalent memory),
+``PADDLE_TPU_PREFIX_CACHE`` (default on for paged), and
+``PADDLE_TPU_KV_DTYPE`` (int8|fp8; quantized KV storage with per-head
+scales dequantized inside the decode kernels — half the HBM bytes per
+step; default full precision).
 """
 from __future__ import annotations
 
@@ -76,17 +79,30 @@ __all__ = ["InferenceEngine", "Request", "default_prefill_buckets"]
 
 def default_prefill_buckets(max_seq_len: int, lo: int = 16) -> List[int]:
     """Powers of two in [lo, max_seq_len], always including max_seq_len.
-    ``PADDLE_TPU_PREFILL_BUCKETS="64,256,1024"`` overrides."""
+    ``PADDLE_TPU_PREFILL_BUCKETS="64,256,1024"`` overrides; between the
+    env and the powers-of-two default sits the unified tuning table
+    (utils.tuning, op "prefill_buckets", key (device_kind, max_seq_len))
+    so a bucket list tuned for a traffic mix persists across restarts."""
     env = os.environ.get("PADDLE_TPU_PREFILL_BUCKETS", "").strip()
     if env:
         bks = sorted({int(x) for x in env.split(",") if x.strip()})
     else:
-        bks = []
-        b = lo
-        while b < max_seq_len:
-            bks.append(b)
-            b *= 2
-        bks.append(max_seq_len)
+        bks = None
+        try:
+            from ..utils import tuning as _tuning
+            tuned = _tuning.lookup("prefill_buckets",
+                                   (_tuning.device_kind(), max_seq_len))
+            if tuned:
+                bks = sorted({int(x) for x in tuned})
+        except (ValueError, TypeError):
+            pass
+        if not bks:
+            bks = []
+            b = lo
+            while b < max_seq_len:
+                bks.append(b)
+                b *= 2
+            bks.append(max_seq_len)
     return [b for b in bks if b <= max_seq_len] or [max_seq_len]
 
 
@@ -155,7 +171,8 @@ class InferenceEngine:
                  kv_layout: Optional[str] = None,
                  kv_block_size: Optional[int] = None,
                  kv_num_blocks: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None):
         model.eval()
         self.model = model
         cfg = model.cfg
@@ -174,6 +191,11 @@ class InferenceEngine:
         if self.kv_layout not in ("dense", "paged"):
             raise ValueError(f"kv_layout must be dense|paged, got "
                              f"{self.kv_layout!r}")
+        # quantized KV storage ('int8'/'fp8'; env PADDLE_TPU_KV_DTYPE):
+        # halves the bytes every decode step streams from HBM.  None =
+        # full-precision cache, the default and the parity oracle.
+        from ..ops.quantized_matmul import resolve_kv_quant
+        self.kv_dtype = resolve_kv_quant(kv_dtype)
 
         # persistent compile cache: a restarted server deserializes its
         # prefill/decode executables instead of recompiling them
@@ -187,7 +209,8 @@ class InferenceEngine:
                              prefix_cache)
         else:
             self.cache = model.init_kv_cache(self.batch_slots,
-                                             self.max_seq_len, cache_dtype)
+                                             self.max_seq_len, cache_dtype,
+                                             kv_dtype=self.kv_dtype)
             self._alloc = None
             self._prefix = None
             if mesh is not None:
@@ -266,7 +289,8 @@ class InferenceEngine:
         # +1: block 0 is the reserved null block unused table entries
         # point at (paged_kv module docstring)
         self.cache = init_paged_cache(self.model, usable + 1, bs,
-                                      cache_dtype)
+                                      cache_dtype,
+                                      kv_dtype=self.kv_dtype)
         self._alloc = BlockAllocator(usable + 1, bs)
         self._tables = np.zeros((self.batch_slots, self.blocks_per_slot),
                                 np.int32)
@@ -291,11 +315,17 @@ class InferenceEngine:
             dp = "dp" if "dp" in names and mesh.shape["dp"] > 1 else None
             tp = "tp" if "tp" in names and mesh.shape["tp"] > 1 else None
             kv_spec = NamedSharding(mesh, P(None, dp, None, tp, None))
+            sc_spec = NamedSharding(mesh, P(None, dp, None, tp))
             len_spec = NamedSharding(mesh, P(dp))
+            scales = (None, None)
+            if self.cache.quantized:
+                scales = (jax.device_put(self.cache.k_scale, sc_spec),
+                          jax.device_put(self.cache.v_scale, sc_spec))
             self.cache = type(self.cache)(
                 jax.device_put(self.cache.k, kv_spec),
                 jax.device_put(self.cache.v, kv_spec),
-                jax.device_put(self.cache.lengths, len_spec))
+                jax.device_put(self.cache.lengths, len_spec),
+                *scales)
         except Exception:  # sharding is an optimization, never fatal
             pass
 
@@ -874,7 +904,8 @@ class InferenceEngine:
         # drop the warmup garbage: zero every slot's length (host-side
         # constant, so no extra executable rides the hot path)
         self.cache = type(cache)(cache.k, cache.v,
-                                 jnp.zeros((self.batch_slots,), jnp.int32))
+                                 jnp.zeros((self.batch_slots,), jnp.int32),
+                                 cache.k_scale, cache.v_scale)
         return self
 
     def _warmup_paged(self, buckets):
@@ -947,6 +978,7 @@ class InferenceEngine:
         s["buckets"] = list(self.buckets)
         s["donate"] = self._donate
         s["kv_layout"] = self.kv_layout
+        s["kv_dtype"] = self.kv_dtype or "dense"
         if self.kv_layout == "paged":
             s["kv_block_size"] = self.block_size
             s["kv_blocks_total"] = self._alloc.capacity

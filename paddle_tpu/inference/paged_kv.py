@@ -57,12 +57,20 @@ class PagedKVCache:
     """Device half of the paged cache: ``k``/``v`` are
     ``[layers, num_blocks, block_size, kv_heads, head_dim]`` block
     pools.  Which blocks belong to which slot is the host allocator's
-    business; the executables receive block tables as operands."""
+    business; the executables receive block tables as operands.
 
-    __slots__ = ("k", "v")
+    Quantized form (``kv_dtype='int8'``/``'fp8'``): the value pools
+    hold 8-bit values and ``k_scale``/``v_scale`` the per-(position,
+    head) f32 scale pools ``[layers, num_blocks, block_size, kv_heads]``
+    — the paged decode kernel streams both and dequantizes in VMEM.
+    Full-precision pools (``k_scale is None``) stay the default and the
+    parity oracle."""
 
-    def __init__(self, k, v):
+    __slots__ = ("k", "v", "k_scale", "v_scale")
+
+    def __init__(self, k, v, k_scale=None, v_scale=None):
         self.k, self.v = k, v
+        self.k_scale, self.v_scale = k_scale, v_scale
 
     @property
     def num_layers(self):
@@ -76,29 +84,42 @@ class PagedKVCache:
     def block_size(self):
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
     def __repr__(self):
         return (f"PagedKVCache(layers={self.k.shape[0]}, "
                 f"blocks={self.k.shape[1]}, block_size={self.k.shape[2]}, "
-                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype})")
+                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype}"
+                f"{', quantized' if self.quantized else ''})")
 
 
 jax.tree_util.register_pytree_node(
     PagedKVCache,
-    lambda c: ((c.k, c.v), None),
+    lambda c: ((c.k, c.v, c.k_scale, c.v_scale), None),
     lambda aux, ch: PagedKVCache(*ch))
 
 
 def init_paged_cache(model, num_blocks: int, block_size: int,
-                     dtype=None) -> PagedKVCache:
+                     dtype=None, kv_dtype=None) -> PagedKVCache:
     """Allocate the zeroed block pool for ``model`` (a GPTForCausalLM /
     GPTModel).  ``num_blocks`` INCLUDES the reserved null block 0, so
-    the usable capacity is ``num_blocks - 1`` blocks."""
+    the usable capacity is ``num_blocks - 1`` blocks.  ``kv_dtype=
+    'int8'``/``'fp8'`` (default from ``PADDLE_TPU_KV_DTYPE``) allocates
+    8-bit value pools plus f32 scale pools."""
+    from ..ops.quantized_matmul import kv_storage_dtype, resolve_kv_quant
     gpt = getattr(model, "gpt", model)
     cfg = gpt.cfg
-    dt = dtype or gpt.wte.weight.dtype
+    mode = resolve_kv_quant(kv_dtype)
+    dt = kv_storage_dtype(mode) if mode else \
+        (dtype or gpt.wte.weight.dtype)
     shape = (cfg.num_layers, int(num_blocks), int(block_size),
              cfg.num_kv_heads, cfg.head_dim)
-    return PagedKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+    scales = (jnp.zeros(shape[:-1], jnp.float32),
+              jnp.zeros(shape[:-1], jnp.float32)) if mode else (None, None)
+    return PagedKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                        *scales)
 
 
 class BlockAllocator:

@@ -61,12 +61,22 @@ class StaticKVCache:
     slice` / scatter); under jit with donated cache operands XLA turns
     them into true in-place writes.  Registered as a pytree so it rides
     through jit/scan/while_loop carries.
+
+    Quantized form (``kv_dtype='int8'``/``'fp8'`` in init_kv_cache):
+    ``k``/``v`` hold 8-bit values and ``k_scale``/``v_scale`` the
+    per-(position, head) f32 scales
+    ``[layers, batch_slots, max_seq, kv_heads]`` — decode streams half
+    the bytes and dequantizes inside the fused attention kernel.  The
+    fp cache (``k_scale is None``) stays the default and the parity
+    oracle; shapes are static either way, so the zero-recompile
+    contract is unchanged.
     """
 
-    __slots__ = ("k", "v", "lengths")
+    __slots__ = ("k", "v", "lengths", "k_scale", "v_scale")
 
-    def __init__(self, k, v, lengths):
+    def __init__(self, k, v, lengths, k_scale=None, v_scale=None):
         self.k, self.v, self.lengths = k, v, lengths
+        self.k_scale, self.v_scale = k_scale, v_scale
 
     @property
     def num_layers(self):
@@ -80,15 +90,20 @@ class StaticKVCache:
     def capacity(self):
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
     def __repr__(self):
         return (f"StaticKVCache(layers={self.k.shape[0]}, "
                 f"slots={self.k.shape[1]}, capacity={self.k.shape[2]}, "
-                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype})")
+                f"kv_heads={self.k.shape[3]}, dtype={self.k.dtype}"
+                f"{', quantized' if self.quantized else ''})")
 
 
 jax.tree_util.register_pytree_node(
     StaticKVCache,
-    lambda c: ((c.k, c.v, c.lengths), None),
+    lambda c: ((c.k, c.v, c.lengths, c.k_scale, c.v_scale), None),
     lambda aux, ch: StaticKVCache(*ch))
 
 
@@ -112,6 +127,14 @@ class GPTConfig:
     # (ops.fused_cross_entropy) — the [B, S, V] logits tensor is never
     # materialized. Requires tie_word_embeddings.
     fused_ce: bool = False
+    # AQT-style quantized compute: 'int8' (or 'fp8' where this jax has
+    # float8) routes every block linear (qkv/out/up/down projections)
+    # through ops.fake_quant_matmul — quantized forward, straight-
+    # through backward — so training sees (and adapts to) quantization
+    # noise while optimizer/params stay fp32/bf16.  Embeddings and the
+    # LM head stay full precision (the standard sensitivity split).
+    # None (default) keeps every path bitwise-identical to unquantized.
+    quantize: Optional[str] = None
     tp_axis: str = "tp"
     # MoE (0 experts = dense; BASELINE.json config #5 switch-transformer)
     moe_num_experts: int = 0
@@ -130,6 +153,18 @@ class GPTConfig:
             self.ffn_hidden_size = 4 * self.hidden_size
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
+        if self.quantize is not None:
+            from ..ops.quantized_matmul import _check_mode
+            _check_mode(self.quantize)
+            if self.moe_num_experts > 0:
+                # the expert FFNs are raw einsums (distributed.moe), not
+                # parallel linears — they would silently stay full
+                # precision while bench reported quantize='int8'
+                raise NotImplementedError(
+                    "quantize with MoE is not supported: expert FFN "
+                    "matmuls (the dominant MoE FLOPs) have no quantized "
+                    "path yet, and quantizing only attention would "
+                    "misattribute the measured MFU")
 
     def is_moe_layer(self, layer_idx: int) -> bool:
         return (self.moe_num_experts > 0 and
@@ -188,10 +223,11 @@ class GPTAttention(Layer):
         init = ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
         self.qkv_proj = ColumnParallelLinear(
             h, h + 2 * kv_dim, weight_attr=init, has_bias=True,
-            gather_output=False, axis_name=config.tp_axis)
+            gather_output=False, axis_name=config.tp_axis,
+            quantize=config.quantize)
         self.out_proj = RowParallelLinear(
             h, h, weight_attr=init, has_bias=True, input_is_parallel=True,
-            axis_name=config.tp_axis)
+            axis_name=config.tp_axis, quantize=config.quantize)
         self.dropout = Dropout(config.dropout)
 
     def _sp_active(self, b, s) -> bool:
@@ -362,21 +398,41 @@ class GPTAttention(Layer):
         out = self._attend_fresh(q, k, v, b, s)
         return self._proj_out(out, b, s), k, v
 
-    def forward_decode(self, x, k_layer, v_layer, lengths):
+    def forward_decode(self, x, k_layer, v_layer, lengths,
+                       k_scale=None, v_scale=None):
         """One decode step over a StaticKVCache layer: write each slot's
         new k/v at its own ``lengths[b]`` (scatter), then run the fused
         single-token attention masked to ``j <= lengths[b]``.  x is
         [B, 1, hidden]; k_layer/v_layer [B, cap, Hkv, D]; lengths [B]
         int32 (tokens already in the cache, EXCLUDING this one).
-        Returns ``(out, k_layer, v_layer)``."""
+        Returns ``(out, k_layer, v_layer)``.
+
+        Quantized cache layer: ``k_scale``/``v_scale`` [B, cap, Hkv]
+        f32 — the new token's k/v are quantized per head on write and
+        the fused kernel dequantizes while streaming; returns
+        ``(out, k_layer, v_layer, k_scale, v_scale)``."""
         b = x.shape[0]
         cap = k_layer.shape[1]
         q, k, v = self._qkv_arrays(x)
         idx = jnp.minimum(lengths.astype(jnp.int32), cap - 1)
         rows = jnp.arange(b)
+        from .. import ops as _ops
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_layer.dtype)
+            kq, ks = quantize_kv(k[:, 0], mode)         # [b,Hkv,D],[b,Hkv]
+            vq, vs = quantize_kv(v[:, 0], mode)
+            k_layer = k_layer.at[rows, idx].set(kq)
+            v_layer = v_layer.at[rows, idx].set(vq)
+            k_scale = k_scale.at[rows, idx].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[rows, idx].set(vs.astype(v_scale.dtype))
+            out = _ops.decode_attention(q[:, 0], k_layer, v_layer,
+                                        idx + 1, k_scale, v_scale)
+            out = out[:, None].astype(q.dtype)           # [b, 1, H, D]
+            return (self._proj_out(out, b, 1), k_layer, v_layer,
+                    k_scale, v_scale)
         k_layer = k_layer.at[rows, idx].set(k[:, 0].astype(k_layer.dtype))
         v_layer = v_layer.at[rows, idx].set(v[:, 0].astype(v_layer.dtype))
-        from .. import ops as _ops
         out = _ops.decode_attention(
             q[:, 0].astype(k_layer.dtype), k_layer, v_layer, idx + 1)
         out = out[:, None].astype(q.dtype)               # [b, 1, H, D]
@@ -425,7 +481,8 @@ class GPTAttention(Layer):
                 attn_mask=mask[None, None], training=False).data
         return self._proj_out(out, b, s), k_buf, v_buf
 
-    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths):
+    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths,
+                             k_scale=None, v_scale=None):
         """One decode step over a PagedKVCache layer: write each slot's
         new k/v at pool position ``(tables[b, lengths[b]//bs],
         lengths[b]%bs)`` (scatter), then run the paged fused attention
@@ -434,7 +491,12 @@ class GPTAttention(Layer):
         lengths [B] int32 EXCLUDING the new token.  Inactive slots write
         into the reserved null block (their table rows are all-zero) —
         masked garbage by construction.  Returns
-        ``(out, k_pool, v_pool)``."""
+        ``(out, k_pool, v_pool)``.
+
+        Quantized pools: ``k_scale``/``v_scale`` [num_blocks, bs, Hkv]
+        f32 — new k/v quantized per head on write, scales streamed and
+        dequantized inside the paged kernel; returns
+        ``(out, k_pool, v_pool, k_scale, v_scale)``."""
         b = x.shape[0]
         bs = k_pool.shape[1]
         mb = tables.shape[1]
@@ -444,9 +506,24 @@ class GPTAttention(Layer):
         off = lens % bs
         rows = jnp.arange(b)
         blk = tables[rows, blk_pos]
+        from .. import ops as _ops
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_pool.dtype)
+            kq, ks = quantize_kv(k[:, 0], mode)         # [b,Hkv,D],[b,Hkv]
+            vq, vs = quantize_kv(v[:, 0], mode)
+            k_pool = k_pool.at[blk, off].set(kq)
+            v_pool = v_pool.at[blk, off].set(vq)
+            k_scale = k_scale.at[blk, off].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[blk, off].set(vs.astype(v_scale.dtype))
+            out = _ops.paged_decode_attention(
+                q[:, 0], k_pool, v_pool, tables, lens + 1,
+                k_scale, v_scale)
+            out = out[:, None].astype(q.dtype)           # [b, 1, H, D]
+            return (self._proj_out(out, b, 1), k_pool, v_pool,
+                    k_scale, v_scale)
         k_pool = k_pool.at[blk, off].set(k[:, 0].astype(k_pool.dtype))
         v_pool = v_pool.at[blk, off].set(v[:, 0].astype(v_pool.dtype))
-        from .. import ops as _ops
         out = _ops.paged_decode_attention(
             q[:, 0].astype(k_pool.dtype), k_pool, v_pool, tables,
             lens + 1)
@@ -521,11 +598,12 @@ class GPTMLP(Layer):
                 2.0 * config.num_layers)))
         self.up_proj = ColumnParallelLinear(
             config.hidden_size, config.ffn_hidden_size, weight_attr=init,
-            gather_output=False, axis_name=config.tp_axis)
+            gather_output=False, axis_name=config.tp_axis,
+            quantize=config.quantize)
         self.down_proj = RowParallelLinear(
             config.ffn_hidden_size, config.hidden_size,
             weight_attr=out_init, input_is_parallel=True,
-            axis_name=config.tp_axis)
+            axis_name=config.tp_axis, quantize=config.quantize)
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
@@ -577,8 +655,17 @@ class GPTBlock(Layer):
         x = x + self.mlp(self.ln_2(x))
         return x, k, v
 
-    def forward_decode(self, x, k_layer, v_layer, lengths):
-        """Single-token block step over one StaticKVCache layer."""
+    def forward_decode(self, x, k_layer, v_layer, lengths,
+                       k_scale=None, v_scale=None):
+        """Single-token block step over one StaticKVCache layer
+        (quantized layers thread their scale planes through)."""
+        if k_scale is not None:
+            a, k_layer, v_layer, k_scale, v_scale = \
+                self.attn.forward_decode(self.ln_1(x), k_layer, v_layer,
+                                         lengths, k_scale, v_scale)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, k_layer, v_layer, k_scale, v_scale
         a, k_layer, v_layer = self.attn.forward_decode(
             self.ln_1(x), k_layer, v_layer, lengths)
         x = x + a
@@ -593,8 +680,18 @@ class GPTBlock(Layer):
         x = x + self.mlp(self.ln_2(x))
         return x, k_buf, v_buf
 
-    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths):
-        """Single-token block step over one PagedKVCache layer."""
+    def forward_decode_paged(self, x, k_pool, v_pool, tables, lengths,
+                             k_scale=None, v_scale=None):
+        """Single-token block step over one PagedKVCache layer
+        (quantized pools thread their scale pools through)."""
+        if k_scale is not None:
+            a, k_pool, v_pool, k_scale, v_scale = \
+                self.attn.forward_decode_paged(
+                    self.ln_1(x), k_pool, v_pool, tables, lengths,
+                    k_scale, v_scale)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, k_pool, v_pool, k_scale, v_scale
         a, k_pool, v_pool = self.attn.forward_decode_paged(
             self.ln_1(x), k_pool, v_pool, tables, lengths)
         x = x + a
@@ -661,6 +758,31 @@ class GPTModel(Layer):
         params) are re-checked at trace time; when they fail the plain
         scan runs and GSPMD places the stage-3 gathers itself."""
         self._zero3_axis = axis
+        return self
+
+    def enable_quantize(self, mode: Optional[str] = "int8"):
+        """strategy.qat hook: flip every block linear (qkv/out/up/down)
+        onto the fake-quant AQT path (ops.fake_quant_matmul — quantized
+        forward, straight-through backward) after construction.  ``None``
+        restores the exact unquantized lowering.  Parameter names,
+        dtypes and state dicts are untouched — only the forward matmul
+        routing changes, so the optimizer never notices."""
+        if mode is not None:
+            from ..ops.quantized_matmul import _check_mode
+            _check_mode(mode)
+            if self.cfg.moe_num_experts > 0:
+                raise NotImplementedError(
+                    "enable_quantize on a MoE model is not supported: "
+                    "expert FFN matmuls have no quantized path yet "
+                    "(see GPTConfig.quantize)")
+        self.cfg = replace(self.cfg, quantize=mode)
+        for blk in self.blocks:
+            for lin in (blk.attn.qkv_proj, blk.attn.out_proj):
+                lin.quantize = mode
+            for name in ("up_proj", "down_proj"):
+                lin = getattr(blk.mlp, name, None)
+                if lin is not None:
+                    lin.quantize = mode
         return self
 
     def _zero3_mesh(self, x):
@@ -750,18 +872,29 @@ class GPTModel(Layer):
 
     # ---- serving path: static KV cache --------------------------------
     def init_kv_cache(self, batch_slots: int, capacity: Optional[int] = None,
-                      dtype=None) -> StaticKVCache:
+                      dtype=None, kv_dtype=None) -> StaticKVCache:
         """Allocate the fixed-shape serving cache
         ``[layers, batch_slots, capacity, kv_heads, head_dim]`` (zeros;
         per-slot lengths 0). ``capacity`` defaults to max_seq_len;
-        ``dtype`` defaults to the embedding dtype."""
+        ``dtype`` defaults to the embedding dtype.  ``kv_dtype='int8'``
+        (or ``'fp8'``; default from ``PADDLE_TPU_KV_DTYPE``) stores
+        8-bit values plus per-(position, head) f32 scale planes — half
+        the decode HBM traffic, dequantized inside the fused kernel."""
+        from ..ops.quantized_matmul import (kv_storage_dtype,
+                                            resolve_kv_quant)
         cfg = self.cfg
         cap = int(capacity or cfg.max_seq_len)
-        dt = dtype or self.wte.weight.dtype
+        mode = resolve_kv_quant(kv_dtype)
+        dt = kv_storage_dtype(mode) if mode else \
+            (dtype or self.wte.weight.dtype)
         shape = (cfg.num_layers, int(batch_slots), cap,
                  cfg.num_kv_heads, cfg.head_dim)
+        scales = (jnp.zeros(shape[:-1], jnp.float32),
+                  jnp.zeros(shape[:-1], jnp.float32)) if mode \
+            else (None, None)
         return StaticKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
-                             jnp.zeros((int(batch_slots),), jnp.int32))
+                             jnp.zeros((int(batch_slots),), jnp.int32),
+                             *scales)
 
     def forward_prefill(self, input_ids, cache: StaticKVCache, slot,
                         prompt_len):
@@ -787,6 +920,20 @@ class GPTModel(Layer):
         v_new = jnp.stack(vs)[:, None]
         slot = jnp.asarray(slot, jnp.int32)
         zero = jnp.asarray(0, jnp.int32)
+        k_scale = v_scale = None
+        if cache.quantized:
+            # attention ran on the full-precision k/v above (bitwise
+            # the dense prefill); only the STORED copy is quantized
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(cache.k.dtype)
+            k_new, k_s = quantize_kv(k_new, mode)   # [L,1,s,Hkv]
+            v_new, v_s = quantize_kv(v_new, mode)
+            k_scale = jax.lax.dynamic_update_slice(
+                cache.k_scale, k_s.astype(cache.k_scale.dtype),
+                (zero, slot, zero, zero))
+            v_scale = jax.lax.dynamic_update_slice(
+                cache.v_scale, v_s.astype(cache.v_scale.dtype),
+                (zero, slot, zero, zero))
         cache_k = jax.lax.dynamic_update_slice(
             cache.k, k_new.astype(cache.k.dtype),
             (zero, slot, zero, zero, zero))
@@ -795,7 +942,8 @@ class GPTModel(Layer):
             (zero, slot, zero, zero, zero))
         lengths = cache.lengths.at[slot].set(
             jnp.asarray(prompt_len, jnp.int32))
-        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths)
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths,
+                                           k_scale, v_scale)
 
     def forward_decode(self, tokens, cache: StaticKVCache, active):
         """One decode step for every slot: append ``tokens [B]`` at each
@@ -813,15 +961,24 @@ class GPTModel(Layer):
             self.wpe(Tensor(pos.reshape(b, 1)))
         x = self.drop(x)
         cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
         for i, blk in enumerate(self.blocks):
-            x, k_layer, v_layer = blk.forward_decode(
-                x, cache_k[i], cache_v[i], cache.lengths)
+            if k_sc is not None:
+                x, k_layer, v_layer, ks_l, vs_l = blk.forward_decode(
+                    x, cache_k[i], cache_v[i], cache.lengths,
+                    k_sc[i], v_sc[i])
+                k_sc = k_sc.at[i].set(ks_l)
+                v_sc = v_sc.at[i].set(vs_l)
+            else:
+                x, k_layer, v_layer = blk.forward_decode(
+                    x, cache_k[i], cache_v[i], cache.lengths)
             cache_k = cache_k.at[i].set(k_layer)
             cache_v = cache_v.at[i].set(v_layer)
         lengths = jnp.minimum(
             cache.lengths + jnp.asarray(active, jnp.int32),
             cache.capacity)
-        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths)
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths,
+                                           k_sc, v_sc)
 
     # ---- serving path: paged KV cache ---------------------------------
     def forward_prefill_paged(self, input_ids, cache, table_row,
@@ -852,18 +1009,54 @@ class GPTModel(Layer):
         x = self.drop(x)
         table_row = jnp.asarray(table_row, jnp.int32)
         cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
+        quantized = k_sc is not None
+        if quantized:
+            from ..ops.quantized_matmul import (dequantize_kv,
+                                                kv_quant_mode,
+                                                quantize_kv)
+            mode = kv_quant_mode(cache_k.dtype)
         for i, blk in enumerate(self.blocks):
-            k_buf = cache_k[i][table_row].reshape(mb * bs, hkv, dh)
-            v_buf = cache_v[i][table_row].reshape(mb * bs, hkv, dh)
+            if quantized:
+                # gather int8 blocks + scale planes, DEQUANTIZE into an
+                # f32 working buffer, then requantize on the scatter
+                # back.  The buffer must stay f32 end to end: in f32,
+                # requantization of untouched prefix positions is exact
+                # (amax positions quantize to ±127, so round(q·s/s')
+                # reproduces q bit for bit) — a bf16 buffer would round
+                # q·s first and drift the shared prefix codes on every
+                # radix-cache hit.  Attention dtype is unaffected: the
+                # block casts the buffer to q.dtype before attending.
+                k_buf = dequantize_kv(
+                    cache_k[i][table_row], k_sc[i][table_row],
+                    jnp.float32).reshape(mb * bs, hkv, dh)
+                v_buf = dequantize_kv(
+                    cache_v[i][table_row], v_sc[i][table_row],
+                    jnp.float32).reshape(mb * bs, hkv, dh)
+            else:
+                k_buf = cache_k[i][table_row].reshape(mb * bs, hkv, dh)
+                v_buf = cache_v[i][table_row].reshape(mb * bs, hkv, dh)
             x, k_buf, v_buf = blk.forward_prefill_paged(
                 x, k_buf, v_buf, prefix_len)
             # duplicate table entries (trailing null-block slots) scatter
             # identical gathered-back values — benign by construction
-            cache_k = cache_k.at[i, table_row].set(
-                k_buf.reshape(mb, bs, hkv, dh))
-            cache_v = cache_v.at[i, table_row].set(
-                v_buf.reshape(mb, bs, hkv, dh))
-        return self.ln_f(x), type(cache)(cache_k, cache_v)
+            if quantized:
+                kq, ks = quantize_kv(k_buf, mode)
+                vq, vs = quantize_kv(v_buf, mode)
+                cache_k = cache_k.at[i, table_row].set(
+                    kq.reshape(mb, bs, hkv, dh))
+                cache_v = cache_v.at[i, table_row].set(
+                    vq.reshape(mb, bs, hkv, dh))
+                k_sc = k_sc.at[i, table_row].set(
+                    ks.reshape(mb, bs, hkv).astype(k_sc.dtype))
+                v_sc = v_sc.at[i, table_row].set(
+                    vs.reshape(mb, bs, hkv).astype(v_sc.dtype))
+            else:
+                cache_k = cache_k.at[i, table_row].set(
+                    k_buf.reshape(mb, bs, hkv, dh))
+                cache_v = cache_v.at[i, table_row].set(
+                    v_buf.reshape(mb, bs, hkv, dh))
+        return self.ln_f(x), type(cache)(cache_k, cache_v, k_sc, v_sc)
 
     def forward_decode_paged(self, tokens, cache, tables, lengths):
         """One decode step for every slot over the PAGED cache: append
@@ -883,12 +1076,20 @@ class GPTModel(Layer):
             self.wpe(Tensor(pos.reshape(b, 1)))
         x = self.drop(x)
         cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
         for i, blk in enumerate(self.blocks):
-            x, k_pool, v_pool = blk.forward_decode_paged(
-                x, cache_k[i], cache_v[i], tables, lens)
+            if k_sc is not None:
+                x, k_pool, v_pool, ks_p, vs_p = blk.forward_decode_paged(
+                    x, cache_k[i], cache_v[i], tables, lens,
+                    k_sc[i], v_sc[i])
+                k_sc = k_sc.at[i].set(ks_p)
+                v_sc = v_sc.at[i].set(vs_p)
+            else:
+                x, k_pool, v_pool = blk.forward_decode_paged(
+                    x, cache_k[i], cache_v[i], tables, lens)
             cache_k = cache_k.at[i].set(k_pool)
             cache_v = cache_v.at[i].set(v_pool)
-        return self.ln_f(x), type(cache)(cache_k, cache_v)
+        return self.ln_f(x), type(cache)(cache_k, cache_v, k_sc, v_sc)
 
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.recompute import recompute as _rc
@@ -938,6 +1139,11 @@ class GPTForCausalLM(Layer):
         self.gpt.enable_zero3_overlap(axis)
         return self
 
+    def enable_quantize(self, mode: Optional[str] = "int8"):
+        self.gpt.enable_quantize(mode)
+        self.cfg = self.gpt.cfg
+        return self
+
     def _tp_size(self) -> int:
         from ..distributed.mesh import get_mesh
         m = get_mesh()
@@ -967,8 +1173,9 @@ class GPTForCausalLM(Layer):
 
     # ---- serving path -------------------------------------------------
     def init_kv_cache(self, batch_slots: int, capacity: Optional[int] = None,
-                      dtype=None) -> StaticKVCache:
-        return self.gpt.init_kv_cache(batch_slots, capacity, dtype)
+                      dtype=None, kv_dtype=None) -> StaticKVCache:
+        return self.gpt.init_kv_cache(batch_slots, capacity, dtype,
+                                      kv_dtype)
 
     def _head_logits(self, hidden):
         """hidden Tensor [..., H] -> logits Tensor [..., V]."""

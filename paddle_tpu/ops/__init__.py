@@ -14,3 +14,6 @@ from .decode_attention import (  # noqa: F401
     paged_decode_attention, paged_decode_attention_available)
 from .fused_cross_entropy import (  # noqa: F401
     fused_linear_cross_entropy, pick_vocab_block)
+from .quantized_matmul import (  # noqa: F401
+    quantized_matmul, quantized_matmul_available, fake_quant_matmul,
+    quantize_channel, quantize_kv, dequantize_kv, get_qmm_tiles)

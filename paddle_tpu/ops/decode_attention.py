@@ -20,6 +20,16 @@ keeps the kernel free of SMEM scalar plumbing.
 
 The XLA composite (`_decode_composite`) is the CPU/fallback path and the
 ground truth for the kernel tests; both use f32 score accumulation.
+
+Quantized KV (``kv_dtype='int8'`` in the caches): both entry points
+accept optional per-(position, head) ``k_scale``/``v_scale`` arrays
+(``[B, S, Hkv]`` dense / ``[num_blocks, block_size, Hkv]`` paged, f32;
+see ops.quantized_matmul.quantize_kv).  The kernels stream the int8
+values + f32 scales and dequantize INSIDE the block loop, so the bytes
+leaving HBM per decode step halve (decode attention is bandwidth-bound
+— that is the whole win); the composites dequantize up front and reuse
+the dense math, which makes them the parity oracle against the fp
+cache at quantization tolerance.
 """
 from __future__ import annotations
 
@@ -133,9 +143,94 @@ def _decode_gqa(q3, k3, v3, mask, block_k=512):
     )(q3, k3, v3, mask)
 
 
-def _decode_composite(q, k_cache, v_cache, lengths):
+def _decode_kernel_q(q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref, o_ref,
+                     *, block_k: int, scale: float):
+    """Quantized-cache variant of _decode_kernel: k/v strips arrive in
+    int8 with per-position f32 scale strips ((1, S), like the mask) and
+    are dequantized block-by-block AFTER leaving HBM — the strips
+    stream at half the bytes, which is the whole point of the int8
+    cache on a bandwidth-bound kernel."""
+    g, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+
+    q = q_ref[:]
+    m0 = jnp.full((g, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        ks = ks_ref[0, pl.ds(j * block_k, block_k)]         # (bk,) f32
+        vs = vs_ref[0, pl.ds(j * block_k, block_k)]
+        k_blk = (k_ref[pl.ds(j * block_k, block_k), :]
+                 .astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+        v_blk = (v_ref[pl.ds(j * block_k, block_k), :]
+                 .astype(jnp.float32) * vs[:, None]).astype(q.dtype)
+        sblk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [g, bk] f32
+        kv_f = m_ref[0, pl.ds(j * block_k, block_k)]        # (bk,) f32
+        sblk = jnp.where(kv_f[None, :] > 0, sblk, _NEG)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        p = jnp.where(sblk <= _NEG / 2, 0.0, p)  # fully-masked blocks
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _decode_gqa_q(q3, k3, v3, ks3, vs3, mask, block_k=512):
+    """Quantized wrapper: q3 [B·Hkv, G, D]; k3/v3 [B·Hkv, S, D] int8;
+    ks3/vs3 [B·Hkv, 1, S] f32 scales; mask [B, 1, S] f32."""
+    bhkv, g, d = q3.shape
+    s = k3.shape[1]
+    hkv = bhkv // mask.shape[0]
+    block_k = _fa._pick_block(s, block_k)
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_decode_kernel_q, block_k=block_k,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(bhkv,),
+        in_specs=[
+            pl.BlockSpec((None, g, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s), lambda b: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s),
+                         lambda b, hkv=hkv: (b // hkv, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, g, d), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhkv, g, d), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, ks3, vs3, mask)
+
+
+def _dequant_cache(cache, scale, dtype):
+    """int8/f8 cache values [..., Hkv, D] × per-(position, head) scales
+    [..., Hkv] -> compute dtype."""
+    return (cache.astype(jnp.float32) *
+            scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def _decode_composite(q, k_cache, v_cache, lengths, k_scale=None,
+                      v_scale=None):
     """XLA reference math. q [B, H, D]; caches [B, S, Hkv, D]; lengths
-    [B] int32 (valid tokens per slot, INCLUDING the one just written)."""
+    [B] int32 (valid tokens per slot, INCLUDING the one just written).
+    With ``k_scale``/``v_scale`` ([B, S, Hkv] f32) the caches hold
+    quantized values: dequantize up front, then the IDENTICAL dense
+    math — bitwise the dense composite on the dequantized contents."""
+    if k_scale is not None:
+        k_cache = _dequant_cache(k_cache, k_scale, q.dtype)
+        v_cache = _dequant_cache(v_cache, v_scale, q.dtype)
     b, h, d = q.shape
     s, hkv = k_cache.shape[1], k_cache.shape[2]
     g = h // hkv
@@ -152,28 +247,42 @@ def _decode_composite(q, k_cache, v_cache, lengths):
     return out.reshape(b, h, d).astype(q.dtype)
 
 
-def decode_attention(q, k_cache, v_cache, lengths):
+def decode_attention(q, k_cache, v_cache, lengths, k_scale=None,
+                     v_scale=None):
     """Single-token attention over a static, length-masked KV cache.
 
     q ``[B, H, D]`` — the new token's query per slot; k_cache/v_cache
     ``[B, S, Hkv, D]`` — fixed-capacity cache AFTER the new token's k/v
     were written; lengths ``[B]`` int32 — valid tokens per slot
-    (including the new one).  Returns ``[B, H, D]``.  GQA is native
-    (H % Hkv == 0, grouped ``h = hk·G + g`` like flash_attention).
-    Pallas fused kernel when shapes allow, XLA composite otherwise.
+    (including the new one).  With a quantized cache, ``k_scale``/
+    ``v_scale`` carry the per-(position, head) f32 scales
+    (``[B, S, Hkv]``) and the cache values are int8 (fp8 rides the
+    composite).  Returns ``[B, H, D]``.  GQA is native (H % Hkv == 0,
+    grouped ``h = hk·G + g`` like flash_attention).  Pallas fused
+    kernel when shapes allow, XLA composite otherwise.
     """
     b, h, d = q.shape
     s, hkv = k_cache.shape[1], k_cache.shape[2]
+    quantized = k_scale is not None
     supported = (s % 128 == 0 and (d % 128 == 0 or d == 64)
-                 and h % hkv == 0)
+                 and h % hkv == 0
+                 and (not quantized or k_cache.dtype == jnp.int8))
     if not supported or not decode_attention_available():
-        return _decode_composite(q, k_cache, v_cache, lengths)
+        return _decode_composite(q, k_cache, v_cache, lengths,
+                                 k_scale, v_scale)
     mask = (jnp.arange(s)[None, :] <
             lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
     q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
     k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
     v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
-    o3 = _decode_gqa(q3, k3, v3, mask.reshape(b, 1, s))
+    if quantized:
+        ks3 = jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2) \
+            .reshape(b * hkv, 1, s)
+        vs3 = jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2) \
+            .reshape(b * hkv, 1, s)
+        o3 = _decode_gqa_q(q3, k3, v3, ks3, vs3, mask.reshape(b, 1, s))
+    else:
+        o3 = _decode_gqa(q3, k3, v3, mask.reshape(b, 1, s))
     return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)
 
 
@@ -274,19 +383,117 @@ def _paged_gqa(q3, k_pool, v_pool, tables, lengths):
       q3, k_pool, v_pool)
 
 
-def _paged_composite(q, k_pool, v_pool, tables, lengths):
+def _paged_composite(q, k_pool, v_pool, tables, lengths, k_scale=None,
+                     v_scale=None):
     """XLA reference math: gather each slot's blocks into the dense
     ``[B, S, Hkv, D]`` layout (S = MB·bs) and reuse the dense composite.
     Bitwise-identical to the dense path on identical cache contents —
-    the parity oracle tests/test_paged_kv.py leans on."""
+    the parity oracle tests/test_paged_kv.py leans on.  Quantized pools
+    gather their ``[num_blocks, bs, Hkv]`` scale pools the same way."""
     b, mb = tables.shape
     bs, hkv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
     kg = k_pool[tables].reshape(b, mb * bs, hkv, d)
     vg = v_pool[tables].reshape(b, mb * bs, hkv, d)
-    return _decode_composite(q, kg, vg, lengths)
+    ksg = vsg = None
+    if k_scale is not None:
+        ksg = k_scale[tables].reshape(b, mb * bs, hkv)
+        vsg = v_scale[tables].reshape(b, mb * bs, hkv)
+    return _decode_composite(q, kg, vg, lengths, ksg, vsg)
 
 
-def paged_decode_attention(q, k_pool, v_pool, tables, lengths):
+def _paged_kernel_q(tbl_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                    vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                    block_size: int, hkv: int, scale: float):
+    """Quantized-pool variant of _paged_kernel: the BlockSpec index_map
+    resolved table entry j to a pool block for the int8 values AND the
+    f32 scale strip ([1, bs], from the [NB, Hkv, bs]-transposed scale
+    pools); dequantize after the DMA, then the same online softmax."""
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    b = pl.program_id(0) // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:]                                        # [G, D]
+    ks = ks_ref[0, :]                                   # (bs,) f32
+    vs = vs_ref[0, :]
+    k_blk = (k_ref[:].astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+    v_blk = (v_ref[:].astype(jnp.float32) * vs[:, None]).astype(q.dtype)
+    sblk = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [G, bs] f32
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    sblk = jnp.where(pos < len_ref[b], sblk, _NEG)
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+    p = jnp.exp(sblk - m_new)
+    p = jnp.where(sblk <= _NEG / 2, 0.0, p)             # fully-masked blocks
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_gqa_q(q3, k_pool, v_pool, k_scale, v_scale, tables, lengths):
+    """Quantized paged wrapper: value pools int8 [NB, bs, Hkv, D],
+    scale pools [NB, bs, Hkv] f32 (transposed here to [NB, Hkv, bs] so
+    each grid step's scale block is a 2-D [1, bs] strip)."""
+    pltpu = _fa.pltpu
+    bhkv, g, d = q3.shape
+    bs = k_pool.shape[1]
+    b, mb = tables.shape
+    hkv = bhkv // b
+    scale = 1.0 / math.sqrt(d)
+    ks_t = jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2)
+    vs_t = jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2)
+    kv_spec = pl.BlockSpec(
+        (None, bs, None, d),
+        lambda i, j, tbl, lens, hkv=hkv: (tbl[i // hkv, j], 0, i % hkv, 0))
+    sc_spec = pl.BlockSpec(
+        (None, 1, bs),
+        lambda i, j, tbl, lens, hkv=hkv: (tbl[i // hkv, j], i % hkv, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bhkv, mb),
+        in_specs=[
+            pl.BlockSpec((None, g, d), lambda i, j, tbl, lens: (i, 0, 0)),
+            kv_spec, kv_spec, sc_spec, sc_spec,
+        ],
+        out_specs=pl.BlockSpec((None, g, d),
+                               lambda i, j, tbl, lens: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 128), jnp.float32),   # running max
+            pltpu.VMEM((g, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((g, d), jnp.float32),     # output accumulator
+        ],
+    )
+    kernel = functools.partial(_paged_kernel_q, block_size=bs, hkv=hkv,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bhkv, g, d), q3.dtype),
+        interpret=_interpret(),
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q3, k_pool, v_pool, ks_t, vs_t)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                           k_scale=None, v_scale=None):
     """Single-token attention over a PAGED, length-masked KV cache.
 
     q ``[B, H, D]`` — the new token's query per slot; k_pool/v_pool
@@ -294,17 +501,27 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths):
     the new token's k/v were written; tables ``[B, max_blocks]`` int32 —
     per-slot block table (pool indices; entries past the slot's extent
     point at the reserved null block and stay masked); lengths ``[B]``
-    int32 — valid tokens per slot including the new one.  Returns
-    ``[B, H, D]``.  The Pallas kernel streams K/V block-by-block through
+    int32 — valid tokens per slot including the new one.  With a
+    quantized pool, ``k_scale``/``v_scale`` are the
+    ``[num_blocks, block_size, Hkv]`` f32 scale pools and the value
+    pools are int8 (fp8 rides the composite).  Returns ``[B, H, D]``.
+    The Pallas kernel streams K/V (and scales) block-by-block through
     the block table via scalar prefetch; the XLA composite gathers the
     table into dense form and is the CPU/fallback ground truth.
     """
     b, h, d = q.shape
     bs, hkv = k_pool.shape[1], k_pool.shape[2]
+    quantized = k_scale is not None
     supported = (bs % 128 == 0 and (d % 128 == 0 or d == 64)
-                 and h % hkv == 0)
+                 and h % hkv == 0
+                 and (not quantized or k_pool.dtype == jnp.int8))
     if not supported or not paged_decode_attention_available():
-        return _paged_composite(q, k_pool, v_pool, tables, lengths)
+        return _paged_composite(q, k_pool, v_pool, tables, lengths,
+                                k_scale, v_scale)
     q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
-    o3 = _paged_gqa(q3, k_pool, v_pool, tables, lengths)
+    if quantized:
+        o3 = _paged_gqa_q(q3, k_pool, v_pool, k_scale, v_scale, tables,
+                          lengths)
+    else:
+        o3 = _paged_gqa(q3, k_pool, v_pool, tables, lengths)
     return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)

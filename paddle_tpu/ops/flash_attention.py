@@ -305,8 +305,13 @@ _SWEEP_CANDIDATES = (128, 256, 512, 1024)
 # of seconds of compile+measure per shape, so PADDLE_TPU_FLASH_AUTOTUNE=
 # sweep pays once per (device_kind, seq, head_dim, causal) ACROSS
 # processes, not once per run.  PADDLE_TPU_FLASH_AUTOTUNE_CACHE names the
-# JSON file ("0"/"off" disables persistence; default
-# ~/.cache/paddle_tpu/flash_autotune.json).
+# legacy JSON file ("0"/"off" disables persistence; default
+# ~/.cache/paddle_tpu/flash_autotune.json).  Sweep winners ALSO land in
+# the unified tuning table (utils.tuning, op "flash_blocks") — the
+# generalization of this cache that serves quantized-matmul tiles, MoE
+# a2a chunks and prefill buckets too; get_block_sizes consults it even
+# outside sweep mode, so a tuned shape from any prior process wins over
+# the built-in table.
 _SWEEP_STORE_STATE = {"loaded": False}
 
 
@@ -321,41 +326,70 @@ def _sweep_store_path():
     return os.path.join(base, "paddle_tpu", "flash_autotune.json")
 
 
+def _unified_table_enabled() -> bool:
+    """Mirror flash winners into (and serve lookups from) the unified
+    tuning table ONLY when the legacy env var is unset: an explicit
+    PADDLE_TPU_FLASH_AUTOTUNE_CACHE pins flash entries to exactly that
+    file (the documented pre-unification contract, and what keeps the
+    legacy round-trip tests hermetic)."""
+    return os.environ.get("PADDLE_TPU_FLASH_AUTOTUNE_CACHE") is None
+
+
 def _sweep_key_str(key) -> str:
     kind, seq, d, causal = key
     return f"{kind}|{seq}|{d}|{int(causal)}"
 
 
 def _load_sweep_store():
-    """Merge the on-disk sweep table into the process cache (once);
-    entries this process already swept win over stale disk entries."""
+    """Merge the on-disk sweep tables into the process cache (once);
+    entries this process already swept win over stale disk entries.
+    Reads the legacy flash_autotune.json first (it predates the unified
+    table, so existing deployments keep their winners), then the
+    unified tuning table's "flash_blocks" entries."""
     if _SWEEP_STORE_STATE["loaded"]:
         return
     _SWEEP_STORE_STATE["loaded"] = True
     path = _sweep_store_path()
-    if not path:
+    if path:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                for k, v in data.items():
+                    parts = str(k).split("|")
+                    if len(parts) != 4:
+                        continue
+                    key = (parts[0], int(parts[1]), int(parts[2]),
+                           bool(int(parts[3])))
+                    _SWEEP_CACHE.setdefault(key, (int(v[0]), int(v[1])))
+        except (OSError, ValueError, TypeError, IndexError, KeyError):
+            pass  # corrupt/unreadable table: sweep again, rewrite it
+    if not _unified_table_enabled():
         return
     try:
-        with open(path) as f:
-            data = json.load(f)
-        if not isinstance(data, dict):
-            return
-        for k, v in data.items():
-            parts = str(k).split("|")
+        from ..utils import tuning as _tuning
+        for parts, v in _tuning.entries("flash_blocks").items():
             if len(parts) != 4:
                 continue
             key = (parts[0], int(parts[1]), int(parts[2]),
                    bool(int(parts[3])))
             _SWEEP_CACHE.setdefault(key, (int(v[0]), int(v[1])))
-    except (OSError, ValueError, TypeError, IndexError, KeyError):
-        pass  # corrupt/unreadable table: sweep again, then rewrite it
+    except (ValueError, TypeError, IndexError, ImportError):
+        pass
 
 
 def _persist_sweep_entry(key, val):
     """Atomic read-modify-write of the sweep table via
     framework.fs.open_for_write (fsync before rename: a crash can never
     commit a truncated table that silently re-costs the sweep);
-    best-effort."""
+    best-effort.  Winners are mirrored into the unified tuning table so
+    every tuning consumer shares one store going forward."""
+    if _unified_table_enabled():
+        try:
+            from ..utils import tuning as _tuning
+            _tuning.record("flash_blocks", key, list(val))
+        except Exception:
+            pass
     path = _sweep_store_path()
     if not path:
         return
@@ -377,21 +411,13 @@ def _persist_sweep_entry(key, val):
 
 
 def _normalize_kind(kind: str) -> str:
-    k = (kind or "").lower()
-    for alias, canon in (("v5 lite", "v5e"), ("v5litepod", "v5e"),
-                         ("v5e", "v5e"), ("v5p", "v5p"),
-                         ("v6 lite", "v6e"), ("v6e", "v6e"),
-                         ("v4", "v4"), ("v3", "v3"), ("v2", "v2")):
-        if alias in k:
-            return canon
-    return k
+    from ..utils import tuning as _tuning
+    return _tuning.normalize_kind(kind)
 
 
 def _device_kind() -> str:
-    try:
-        return _normalize_kind(getattr(jax.devices()[0], "device_kind", ""))
-    except Exception:  # pragma: no cover
-        return ""
+    from ..utils import tuning as _tuning
+    return _tuning.device_kind()
 
 
 def get_block_sizes(seq: int, head_dim: int, causal: bool,
@@ -408,6 +434,17 @@ def get_block_sizes(seq: int, head_dim: int, causal: bool,
         return _pick_block(seq, bq), _pick_block(seq, bk)
     if key in _SWEEP_CACHE:
         return _SWEEP_CACHE[key]
+    # unified tuning table (utils.tuning): a shape swept by ANY prior
+    # process serves here without re-arming sweep mode
+    if _unified_table_enabled():
+        try:
+            from ..utils import tuning as _tuning
+            tuned = _tuning.lookup("flash_blocks", key)
+            if tuned is not None:
+                bq, bk = int(tuned[0]), int(tuned[1])
+                return _pick_block(seq, bq), _pick_block(seq, bk)
+        except (ValueError, TypeError, IndexError):
+            pass
     # sweep only tunes THIS process's device: an explicit foreign
     # device_kind would re-run the sweep forever (the cache is keyed by
     # the local kind) and return tiles tuned for the wrong chip

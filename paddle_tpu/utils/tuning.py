@@ -1,0 +1,176 @@
+"""Unified per-(device_kind, shape, dtype) tuning table.
+
+PR 1 gave flash attention a persistent block-size autotune table
+(`ops/flash_attention.py`: process cache + atomic-rename JSON, corrupt-
+tolerant load).  Every tunable knob since has wanted the same thing —
+quantized-matmul tile sizes, the MoE all-to-all chunk count, the
+engine's prefill bucket list — and re-growing that machinery per op
+would mean four slightly different cache files.  This module is the
+generalization: ONE store, namespaced by op, with the flash pattern
+kept exactly:
+
+- **process cache first** — a sweep result recorded in this process is
+  authoritative for the process lifetime;
+- **on-disk JSON second** — ``PADDLE_TPU_TUNING_CACHE`` names the file
+  ("0"/"off" disables persistence; default
+  ``~/.cache/paddle_tpu/tuning.json``).  Writes go through
+  ``framework.fs.open_for_write`` (fsync before atomic rename), so a
+  crash can never commit a truncated table;
+- **corrupt-tolerant load** — an unreadable/garbage table is treated as
+  empty (the next sweep re-measures and rewrites it), never raised;
+- **opt-in sweeps** — ``PADDLE_TPU_TUNING=sweep`` arms the on-device
+  sweeps of ops that have one (quantized-matmul tiles today; flash
+  keeps its own ``PADDLE_TPU_FLASH_AUTOTUNE=sweep`` knob for
+  compatibility, recording its winners here too).
+
+Key format on disk: ``"<op>|<part>|<part>|..."`` with parts stringified
+(bools as 0/1).  Consumers:
+
+- ``ops.flash_attention.get_block_sizes`` — op ``flash_blocks``, key
+  ``(device_kind, seq, head_dim, causal)``;
+- ``ops.quantized_matmul`` — op ``qmm_tiles``, key
+  ``(device_kind, m_bucket, n, k, dtype)``;
+- ``distributed.overlap.moe_a2a_chunks`` — op ``moe_a2a_chunks``, key
+  ``(device_kind, tokens)``;
+- ``inference.engine.default_prefill_buckets`` — op
+  ``prefill_buckets``, key ``(device_kind, max_seq_len)``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["lookup", "record", "entries", "tuning_path", "device_kind",
+           "normalize_kind", "sweep_enabled", "key_str", "reset_for_tests"]
+
+_lock = threading.RLock()
+# op -> {key_tuple_of_strs: value}; merged from disk once, sweeps win
+_STATE: Dict[str, Any] = {"loaded": False, "cache": {}}
+
+
+# ---------------------------------------------------------------------------
+# device identity (shared with flash_attention, which predates this module)
+# ---------------------------------------------------------------------------
+def normalize_kind(kind: str) -> str:
+    """Canonical short device kind ('TPU v5 lite' -> 'v5e', ...)."""
+    k = (kind or "").lower()
+    for alias, canon in (("v5 lite", "v5e"), ("v5litepod", "v5e"),
+                         ("v5e", "v5e"), ("v5p", "v5p"),
+                         ("v6 lite", "v6e"), ("v6e", "v6e"),
+                         ("v4", "v4"), ("v3", "v3"), ("v2", "v2")):
+        if alias in k:
+            return canon
+    return k
+
+
+def device_kind() -> str:
+    """Normalized kind of the local default device ('' when unknown)."""
+    try:
+        import jax
+        return normalize_kind(getattr(jax.devices()[0], "device_kind", ""))
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def sweep_enabled() -> bool:
+    """The generic opt-in sweep knob (flash keeps its legacy env)."""
+    return os.environ.get("PADDLE_TPU_TUNING", "").strip() == "sweep"
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+def tuning_path() -> Optional[str]:
+    p = os.environ.get("PADDLE_TPU_TUNING_CACHE", "").strip()
+    if p.lower() in ("0", "off", "false", "none"):
+        return None
+    if p:
+        return os.path.expanduser(p)
+    base = os.environ.get("XDG_CACHE_HOME") or \
+        os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "paddle_tpu", "tuning.json")
+
+
+def key_str(op: str, parts) -> str:
+    enc = [str(int(p)) if isinstance(p, bool) else str(p) for p in parts]
+    return "|".join([op] + enc)
+
+
+def _key_tuple(parts) -> Tuple[str, ...]:
+    return tuple(str(int(p)) if isinstance(p, bool) else str(p)
+                 for p in parts)
+
+
+def _load_once() -> None:
+    """Merge the on-disk table into the process cache (once); entries
+    this process already recorded win over stale disk entries."""
+    if _STATE["loaded"]:
+        return
+    _STATE["loaded"] = True
+    path = tuning_path()
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            return
+        for k, v in data.items():
+            parts = str(k).split("|")
+            if len(parts) < 2:
+                continue
+            op, key = parts[0], tuple(parts[1:])
+            _STATE["cache"].setdefault(op, {}).setdefault(key, v)
+    except (OSError, ValueError, TypeError):
+        pass  # corrupt/unreadable table: sweep again, then rewrite it
+
+
+def lookup(op: str, parts) -> Any:
+    """The tuned value for (op, key) or None. Process cache first, then
+    the on-disk table (loaded once per process)."""
+    with _lock:
+        _load_once()
+        return _STATE["cache"].get(op, {}).get(_key_tuple(parts))
+
+
+def entries(op: str) -> Dict[Tuple[str, ...], Any]:
+    """All known entries for one op (copy)."""
+    with _lock:
+        _load_once()
+        return dict(_STATE["cache"].get(op, {}))
+
+
+def record(op: str, parts, value) -> None:
+    """Record a tuned value: process cache immediately, on-disk table
+    best-effort via atomic read-modify-write (fsync before rename)."""
+    with _lock:
+        _load_once()
+        _STATE["cache"].setdefault(op, {})[_key_tuple(parts)] = value
+        path = tuning_path()
+        if not path:
+            return
+        try:
+            data = {}
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    data = loaded
+            except (OSError, ValueError):
+                pass  # corrupt table: overwrite with what we know
+            data[key_str(op, parts)] = value
+            from ..framework.fs import open_for_write
+            with open_for_write(path, "w") as f:
+                json.dump(data, f, indent=0, sort_keys=True)
+        except OSError:
+            pass
+
+
+def reset_for_tests() -> None:
+    """Drop the process cache so the next lookup re-reads the file
+    (tests re-point PADDLE_TPU_TUNING_CACHE at tmp paths)."""
+    with _lock:
+        _STATE["loaded"] = False
+        _STATE["cache"] = {}
