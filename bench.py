@@ -656,7 +656,9 @@ def bench_multichip_child():
     and prints ONE JSON line.  Each phase asserts sync-vs-overlap loss
     parity (rtol 1e-5), zero XLA recompiles across steps 2..N, and that
     the new comm_ms/comm_fraction stats fields exist — a phase failure
-    exits non-zero."""
+    exits non-zero.  The elastic phase additionally proves the ISSUE-10
+    contract: train on dp=8, checkpoint, restore on dp=4 with loss
+    parity and no unexpected recompiles after the restore."""
     import time as _time
     import jax
     from paddle_tpu.testing import multichip
@@ -664,7 +666,8 @@ def bench_multichip_child():
     t0 = _time.perf_counter()
     phases = []
     for fn in (multichip.run_zero3_phase, multichip.run_1f1b_phase,
-               multichip.run_moe_a2a_phase):
+               multichip.run_moe_a2a_phase,
+               multichip.run_elastic_restore_phase):
         r = fn()
         phases.append(r)
         log(f"  multichip phase {r['name']} ok t={r['t_s']}s")

@@ -25,6 +25,21 @@ Two on-disk formats:
   payload is the exact bytes the writer produced. Truncation, partial
   upload, or bitrot all fail validation and resume falls back to the
   previous valid snapshot instead of crashing.
+
+Manifest/state version 2 (elastic cross-topology restore): the state
+dict additionally records the writer's LOGICAL topology — mesh axis
+names + sizes and, per saved tree, each leaf's partition spec (axis
+names and partitioned dims, never device ids) — and MANIFEST.json
+mirrors it (``version: 2``, ``mesh_axes``, per-leaf ``leaves`` entries
+with global shape/dtype/spec).  Because every leaf is stored as its
+GLOBAL host array, a checkpoint is a topology-free artifact:
+``restore_trainer`` rebuilds each leaf against the LIVE trainer's
+``NamedSharding`` via ``jax.make_array_from_callback``, so a dp=8 run
+resumes on dp=4, a ZeRO-3 stage-3 shard set repartitions onto the new
+dp extent, and a pp=4 pipeline's stacked layer slabs re-split over
+pp=2 — no resharding pass over the files, the shapes never changed.
+Version-1 states (no topology record) load unchanged on any mesh whose
+global shapes match, exactly as before.
 """
 from __future__ import annotations
 
@@ -41,13 +56,16 @@ import jax
 
 __all__ = ["save_trainer", "load_trainer", "latest_checkpoint",
            "snapshot_trainer", "restore_trainer", "write_checkpoint",
-           "read_checkpoint", "validate_checkpoint",
-           "checkpoint_candidates", "gc_stale_tmps"]
+           "read_checkpoint", "validate_checkpoint", "read_manifest",
+           "checkpoint_candidates", "gc_stale_tmps", "state_mesh_axes"]
 
 _FORMAT = "paddle_tpu_trainer_ckpt_v1"
 _MANIFEST_FORMAT = "paddle_tpu_ckpt_manifest_v1"
 _MANIFEST = "MANIFEST.json"
 _STATE_ENTRY = "state.pdtrainer"
+# state/manifest layout version: 2 = + mesh_axes / per-leaf sharding
+# specs (topology-free elastic restore); 1 = the PR-2 layout
+_STATE_VERSION = 2
 
 
 def _to_host(tree):
@@ -68,12 +86,103 @@ def _to_host(tree):
 
 
 # ---------------------------------------------------------------------------
+# logical topology metadata (manifest/state v2)
+# ---------------------------------------------------------------------------
+def _spec_to_meta(sharding) -> Optional[list]:
+    """NamedSharding -> JSON-able per-dim spec: each entry is None
+    (replicated), an axis name, or a list of axis names.  Device ids
+    never appear — the spec is LOGICAL, that is what makes the record
+    valid on a different topology."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for e in tuple(spec):
+        out.append(list(e) if isinstance(e, tuple) else e)
+    return out
+
+
+def mesh_axes_of(mesh) -> dict:
+    """{axis name: size} for a jax Mesh (insertion-ordered)."""
+    return {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+
+
+def state_mesh_axes(state: dict) -> Optional[dict]:
+    """The topology a v2 state was written on, or None (legacy v1)."""
+    axes = state.get("mesh_axes")
+    return dict(axes) if isinstance(axes, dict) else None
+
+
+def _trainer_sharding_trees(trainer) -> dict:
+    """{state key: sharding pytree} for every tree snapshot_trainer
+    saves — the source of the per-leaf spec metadata."""
+    trees = {
+        "params": getattr(trainer, "_param_shardings", None),
+        "opt_state": getattr(trainer, "_opt_shardings", None),
+    }
+    if getattr(trainer, "buffers", None):
+        trees["buffers"] = getattr(trainer, "_buffer_shardings", None)
+    if getattr(trainer, "_grad_buf", None) is not None:
+        trees["grad_buf"] = getattr(trainer, "_grad_shardings", None)
+    if getattr(trainer, "_scaler_state", None) is not None:
+        trees["scaler"] = getattr(trainer, "_scaler_shardings", None)
+    if getattr(trainer, "_anomaly_state", None) is not None:
+        trees["anomaly"] = getattr(trainer, "_anomaly_shardings", None)
+    return {k: v for k, v in trees.items() if v is not None}
+
+
+def _topology_record(trainer) -> dict:
+    """mesh_axes + per-tree/per-leaf partition specs for the state dict
+    (pickled whole) and, flattened, for MANIFEST.json."""
+    specs = {}
+    for key, tree in _trainer_sharding_trees(trainer).items():
+        specs[key] = jax.tree_util.tree_map(
+            _spec_to_meta, tree,
+            is_leaf=lambda s: hasattr(s, "spec") or s is None)
+    return {"mesh_axes": mesh_axes_of(trainer.mesh),
+            "sharding_specs": specs}
+
+
+def _manifest_leaves(state: dict) -> dict:
+    """Per-leaf {path: {shape, dtype, spec}} manifest metadata for the
+    v2 state's array trees — human- and tool-readable without
+    unpickling the payload."""
+    out = {}
+    specs = state.get("sharding_specs") or {}
+    for key in ("params", "opt_state", "buffers", "grad_buf",
+                "scaler", "anomaly"):
+        if key not in state:
+            continue
+        leaves = jax.tree_util.tree_flatten_with_path(state[key])[0]
+        spec_tree = specs.get(key)
+        is_spec = lambda x: x is None or (  # noqa: E731
+            isinstance(x, list) and all(
+                e is None or isinstance(e, (str, list)) for e in x))
+        spec_leaves = None
+        if spec_tree is not None:
+            spec_leaves = jax.tree_util.tree_flatten(
+                spec_tree, is_leaf=is_spec)[0]
+            if len(spec_leaves) != len(leaves):
+                spec_leaves = None  # tree drift: keep manifest honest
+        for i, (path, leaf) in enumerate(leaves):
+            name = key + jax.tree_util.keystr(path)
+            ent = {"shape": [int(d) for d in np.shape(leaf)],
+                   "dtype": str(np.asarray(leaf).dtype)}
+            if spec_leaves is not None:
+                ent["spec"] = spec_leaves[i]
+            out[name] = ent
+    return out
+
+
+# ---------------------------------------------------------------------------
 # trainer state <-> host pytree
 # ---------------------------------------------------------------------------
 def snapshot_trainer(trainer, extra: Optional[dict] = None) -> dict:
     """Device -> host snapshot of a trainer's full training state
     (params + optimizer state + step count + LR-scheduler state
-    [+ gradient-merge buffer, fp16 scaler, anomaly counters]).
+    [+ gradient-merge buffer, fp16 scaler, anomaly counters]), plus the
+    v2 topology record (mesh axes + per-leaf logical sharding specs)
+    that makes the checkpoint restorable on a different mesh.
 
     This is the only part of a save that must run on the training
     thread (it synchronizes with the device); serialization and disk
@@ -82,11 +191,17 @@ def snapshot_trainer(trainer, extra: Optional[dict] = None) -> dict:
     from ..optimizer.lr import LRScheduler
     state = {
         "format": _FORMAT,
+        "version": _STATE_VERSION,
         "step_count": trainer._step_count,
         "params": _to_host(trainer.params),
         "opt_state": _to_host(trainer.opt_state),
         "extra": extra or {},
     }
+    # v2 topology record: LOGICAL mesh + per-leaf partition specs.  A
+    # trainer without a mesh (hand-rolled test double) degrades to a
+    # v1-equivalent state that restores on an identical layout only.
+    if getattr(trainer, "mesh", None) is not None:
+        state.update(_topology_record(trainer))
     if getattr(trainer, "buffers", None):
         state["buffers"] = _to_host(trainer.buffers)
     if getattr(trainer, "_grad_buf", None) is not None:
@@ -101,9 +216,33 @@ def snapshot_trainer(trainer, extra: Optional[dict] = None) -> dict:
     return state
 
 
+def _place_leaf(host_arr, dtype, sharding):
+    """Rebuild one GLOBAL host array on the live mesh under `sharding`.
+
+    jax.make_array_from_callback hands each addressable device exactly
+    its shard (the resharding primitive: the callback's index is
+    computed from the NEW NamedSharding, whatever topology wrote the
+    array).  Each shard is materialized as an OWNED copy — on the CPU
+    backend a device_put of a numpy view can be zero-copy, and buffers
+    aliased into later-donated trainer state are the PR-2 hazard."""
+    h = np.asarray(host_arr).astype(dtype, copy=False)
+    if h.ndim == 0:
+        # scalars: the callback indexing protocol is pointless overhead
+        return jax.device_put(h.copy(), sharding)
+    try:
+        return jax.make_array_from_callback(
+            h.shape, sharding, lambda idx: np.array(h[idx]))
+    except Exception:
+        # very old jax / exotic sharding: whole-array placement still
+        # reshards correctly, just without per-shard construction
+        return jax.device_put(np.array(h), sharding)
+
+
 def _restore_tree(host_tree, live_tree, shardings):
-    """device_put each host leaf with the trainer's sharding, verifying
-    structure + shapes against the live state."""
+    """Rebuild each host leaf with the LIVE trainer's sharding,
+    verifying structure + global shapes against the live state.  The
+    shardings (and the mesh under them) come from the trainer, so the
+    checkpoint's topology never constrains the restore."""
     h_leaves, h_def = jax.tree_util.tree_flatten(host_tree)
     l_leaves, l_def = jax.tree_util.tree_flatten(live_tree)
     if h_def != l_def:
@@ -115,17 +254,47 @@ def _restore_tree(host_tree, live_tree, shardings):
         if tuple(h.shape) != tuple(l.shape):
             raise ValueError(
                 f"checkpoint leaf shape {h.shape} != trainer {l.shape}")
-        out.append(jax.device_put(h.astype(l.dtype), s))
+        out.append(_place_leaf(h, l.dtype, s))
     return jax.tree_util.tree_unflatten(l_def, out)
 
 
-def restore_trainer(trainer, state: dict) -> dict:
+def restore_trainer(trainer, state: dict,
+                    elastic: Optional[bool] = None) -> dict:
     """Apply a snapshot_trainer() state dict to a (re)built trainer;
     shardings come from the trainer, so the mesh layout may differ from
-    the one that wrote the checkpoint. Returns the 'extra' dict."""
+    the one that wrote the checkpoint (elastic shrink/grow restore).
+    Returns the 'extra' dict.
+
+    `elastic` gates CROSS-TOPOLOGY restores (v2 states record their
+    mesh): None consults trainer.resume_elastic (default: allowed),
+    False raises on a mesh mismatch instead of silently resharding —
+    the strict mode for jobs whose numerics must be bitwise-stable.
+    The outcome is recorded on the trainer (`_last_restore_info`,
+    `_reshard_restores`) for stats/telemetry."""
     from ..optimizer.lr import LRScheduler
     if state.get("format") != _FORMAT:
         raise ValueError(f"state is not a {_FORMAT} checkpoint")
+    saved_axes = state_mesh_axes(state)
+    live_axes = mesh_axes_of(trainer.mesh) \
+        if getattr(trainer, "mesh", None) is not None else None
+    resharded = (saved_axes is not None and live_axes is not None
+                 and saved_axes != live_axes)
+    if resharded:
+        if elastic is None:
+            elastic = getattr(trainer, "resume_elastic", None)
+        if elastic is False:
+            raise ValueError(
+                f"checkpoint was written on mesh {saved_axes} but the "
+                f"live mesh is {live_axes}; pass resume_elastic=True "
+                f"(or elastic=True) to reshard onto the new topology")
+    trainer._last_restore_info = {
+        "resharded": resharded, "saved_mesh_axes": saved_axes,
+        "mesh_axes": live_axes,
+        "version": int(state.get("version", 1)),
+    }
+    if resharded:
+        trainer._reshard_restores = getattr(
+            trainer, "_reshard_restores", 0) + 1
     trainer.params = _restore_tree(state["params"], trainer.params,
                                    trainer._param_shardings)
     trainer.opt_state = _restore_tree(state["opt_state"],
@@ -223,24 +392,40 @@ def write_checkpoint(state: dict, path: str) -> str:
     CheckpointManager), never a half-committed final directory.
     """
     from ..framework.fs import fsync_file, _fsync_dir
+    from ..testing import faults as _faults
     tmp = path + ".tmp"
     _rm(tmp)
     os.makedirs(tmp)
     payload = pickle.dumps(state, protocol=4)
+    # fault point (PADDLE_FAULT_CKPT_TRUNCATE): die mid-commit leaving
+    # a PARTIAL shard at the final path — the manifest records the full
+    # payload, so the committed dir exists but fails validation, which
+    # is exactly what resume's fallback walk must survive
+    truncate_and_die = _faults.ckpt_truncate_commit()
+    body = payload if not truncate_and_die \
+        else payload[:max(1, len(payload) // 2)]
     with open(os.path.join(tmp, _STATE_ENTRY), "wb") as f:
-        f.write(payload)
+        f.write(body)
         fsync_file(f)
     manifest = {
         "format": _MANIFEST_FORMAT,
+        "version": int(state.get("version", 1)),
         "step": int(state.get("step_count", -1)),
         "entries": {_STATE_ENTRY: {
             "sha256": hashlib.sha256(payload).hexdigest(),
             "size": len(payload),
         }},
     }
+    if state_mesh_axes(state) is not None:
+        manifest["mesh_axes"] = state_mesh_axes(state)
+        manifest["leaves"] = _manifest_leaves(state)
     with open(os.path.join(tmp, _MANIFEST), "w") as f:
         json.dump(manifest, f)
         fsync_file(f)
+    if truncate_and_die:
+        _rm(path)
+        os.rename(tmp, path)   # committed-looking, but the shard is cut
+        os._exit(137)          # SIGKILL-style death, no cleanup
     if os.path.exists(path):
         # re-save of the same step: rename the old one aside first so
         # the no-checkpoint window is two rename syscalls, not a
@@ -294,6 +479,20 @@ def validate_checkpoint(path: str) -> bool:
         return False
 
 
+def read_manifest(path: str) -> Optional[dict]:
+    """MANIFEST.json of a directory checkpoint (None for legacy single
+    files / missing manifest).  The cheap way to learn a checkpoint's
+    step, version and — v2 — the mesh it was written on, without
+    unpickling a multi-GB payload."""
+    if not os.path.isdir(path):
+        return None
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def read_checkpoint(path: str) -> dict:
     """Load a checkpoint state dict from either format, verifying the
     manifest for directory checkpoints (raises ValueError on corruption
@@ -331,13 +530,16 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None,
     return path
 
 
-def load_trainer(trainer, path: str) -> dict:
+def load_trainer(trainer, path: str,
+                 elastic: Optional[bool] = None) -> dict:
     """Restore a save_trainer checkpoint (either format) into a (re)built
-    trainer. Returns the 'extra' metadata dict."""
+    trainer, resharding onto the trainer's mesh when the checkpoint was
+    written on a different one (see restore_trainer's `elastic`).
+    Returns the 'extra' metadata dict."""
     state = read_checkpoint(path)
     if not isinstance(state, dict) or state.get("format") != _FORMAT:
         raise ValueError(f"{path} is not a {_FORMAT} checkpoint")
-    return restore_trainer(trainer, state)
+    return restore_trainer(trainer, state, elastic=elastic)
 
 
 def latest_checkpoint(directory: str, prefix: str = "ckpt-",

@@ -27,7 +27,43 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-__all__ = ["launch", "get_cluster", "Pod", "TrainerProc", "find_free_port"]
+__all__ = ["launch", "get_cluster", "Pod", "TrainerProc", "find_free_port",
+           "read_hosts_file", "HOSTS_FILE_ENV"]
+
+# elastic membership: a file the scheduler/operator keeps current with
+# the SURVIVING host set (one `ip[:nproc]` per line, '#' comments).
+# When set, every (re)launch attempt re-reads it, so a pod that lost a
+# host after preemption re-forms over the survivors at a smaller world
+# size instead of demanding the original --ips back; the trainers then
+# elastic-restore their checkpoints onto the smaller mesh.
+HOSTS_FILE_ENV = "PADDLE_ELASTIC_HOSTS_FILE"
+
+
+def read_hosts_file(path: Optional[str],
+                    default_nproc: int) -> Optional[list]:
+    """[(ip, nproc)] from an elastic hosts file.  None means 'no
+    membership info' (missing/unreadable file -> caller falls back to
+    the static --ips contract); an EMPTY list is meaningful — the
+    operator truncated the file to say zero hosts survive, and the
+    launcher must give up rather than relaunch at the old world size."""
+    if not path or not os.path.isfile(path):
+        return None
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                ip, _, n = line.partition(":")
+                try:
+                    nproc = int(n) if n else default_nproc
+                except ValueError:
+                    nproc = default_nproc
+                out.append((ip.strip(), max(1, nproc)))
+    except OSError:
+        return None
+    return out
 
 
 def find_free_port() -> int:
@@ -54,14 +90,18 @@ class Pod:
 
 
 def get_cluster(ips: List[str], nproc_per_node: int,
-                start_port: Optional[int] = None):
-    """All endpoints + this host's Pod (reference get_cluster:258)."""
+                start_port: Optional[int] = None,
+                nproc_map: Optional[dict] = None):
+    """All endpoints + this host's Pod (reference get_cluster:258).
+    nproc_map ({ip: nproc}) lets an elastic relaunch give survivors
+    per-host process counts that differ from the static default."""
     endpoints, pods = [], []
     for ip in ips:
+        nproc = (nproc_map or {}).get(ip, nproc_per_node)
         ports = [find_free_port() if (start_port is None and
                                       ip in ("127.0.0.1", "localhost"))
                  else (start_port or 6170) + i
-                 for i in range(nproc_per_node)]
+                 for i in range(nproc)]
         pod = Pod(addr=ip)
         for p in ports:
             pod.ranks.append(len(endpoints))
@@ -238,11 +278,29 @@ def launch(args=None) -> int:
                         help="seconds of trainer silence before the pod "
                              "is declared hung (0 = disabled); trainers "
                              "beat automatically from train_step")
+    parser.add_argument("--elastic_hosts_file", type=str,
+                        default=os.environ.get(HOSTS_FILE_ENV),
+                        help="membership file re-read before every "
+                             "(re)launch attempt: one `ip[:nproc]` per "
+                             "line — the SURVIVING host set. With it, a "
+                             "preemption drain or crash relaunches over "
+                             "whatever hosts remain (smaller world size) "
+                             "and the trainers elastic-restore their "
+                             "checkpoints onto the new mesh, instead of "
+                             "requiring the original --ips world back")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     a = parser.parse_args(args)
 
-    ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
+    static_ips = [ip.strip() for ip in a.ips.split(",") if ip.strip()]
+
+    def _resolve_hosts():
+        """Current host set: the elastic hosts file when given (re-read
+        per attempt — it IS the surviving set), else the static --ips."""
+        hosts = read_hosts_file(a.elastic_hosts_file, a.nproc_per_node)
+        if hosts is None:
+            return static_ips, None
+        return [ip for ip, _ in hosts], {ip: n for ip, n in hosts}
 
     # preemption handling: SIGTERM on the launcher forwards to every
     # trainer so their PreemptionGuards drain the in-flight step and
@@ -266,8 +324,15 @@ def launch(args=None) -> int:
 
     attempts = a.elastic_retries + 1
     for attempt in range(attempts):
-        # fresh ports each attempt: the dead pod's sockets may linger
-        endpoints, pods = get_cluster(ips, a.nproc_per_node, a.start_port)
+        # fresh ports each attempt: the dead pod's sockets may linger;
+        # fresh membership each attempt: survivors only (elastic shrink)
+        ips, nproc_map = _resolve_hosts()
+        if not ips:
+            print("launch: elastic hosts file lists no survivors; "
+                  "giving up", file=sys.stderr, flush=True)
+            return 1
+        endpoints, pods = get_cluster(ips, a.nproc_per_node,
+                                      a.start_port, nproc_map)
         # pick THIS host's pod (reference matches the node ip); each host
         # of a multi-host cluster runs its own launcher over the same
         # --ips
@@ -307,6 +372,18 @@ def launch(args=None) -> int:
         rc = watch_local_trainers(procs,
                                   heartbeat_dir=hb_dir,
                                   heartbeat_timeout=a.heartbeat_timeout)
+        if preempted[0] and a.elastic_hosts_file and \
+                attempt + 1 < attempts:
+            # SIGTERM drain finished (trainers checkpointed + exited):
+            # instead of dying at the original world size, re-form the
+            # mesh from whatever the hosts file NOW lists — the
+            # surviving set — and let auto-resume elastic-restore the
+            # checkpoints onto the smaller (or regrown) topology
+            preempted[0] = False
+            print("launch: preemption drain complete; re-forming from "
+                  "the surviving host set", file=sys.stderr, flush=True)
+            time.sleep(0.5)
+            continue
         if rc == 0 or preempted[0]:
             # clean finish, or a preemption drain (trainers that
             # checkpointed and exited 0 make the whole pod exit 0)

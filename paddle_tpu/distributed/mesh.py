@@ -117,6 +117,14 @@ def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
         shape = list(axes)
         names = list(axis_names or [f"axis{i}" for i in range(len(shape))])
     devs = np.asarray(devices if devices is not None else jax.devices())
+    # deterministic chaos (PADDLE_FAULT_MESH_SHRINK): the scheduler
+    # handed back fewer chips — build the mesh from the survivors only,
+    # so elastic-restore tests exercise a real topology change without
+    # re-execing under a different device-count flag
+    from ..testing import faults as _faults
+    _shrink = _faults.mesh_shrink()
+    if _shrink is not None and _shrink < devs.size:
+        devs = devs.reshape(-1)[:_shrink]
     n = devs.size
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))

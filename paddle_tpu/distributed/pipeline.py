@@ -126,9 +126,20 @@ class GPipeTrainer:
                  strategy: Optional[DistributedStrategy] = None,
                  dedupe_head: bool = True, buffer_mode: str = "forbid",
                  schedule: Optional[str] = None,
-                 comm_stats: Optional[bool] = None):
+                 comm_stats: Optional[bool] = None,
+                 resume_elastic: Optional[bool] = None):
         if pp_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no '{pp_axis}' axis")
+        # elastic resume: the stacked [L, ...] block slabs are saved as
+        # GLOBAL arrays, so a pp=4 checkpoint re-splits onto pp=2 (two
+        # stage param groups merge per rank) by plain resharding.
+        # False = strict same-topology restores only.
+        if resume_elastic is None:
+            resume_elastic = os.environ.get(
+                "PADDLE_TPU_RESUME_ELASTIC", "1") != "0"
+        self.resume_elastic = bool(resume_elastic)
+        self._reshard_restores = 0
+        self._last_restore_info: Optional[dict] = None
         from .overlap import pipeline_schedule_default
         self.schedule = schedule or pipeline_schedule_default()
         if self.schedule not in ("gpipe", "1f1b"):
@@ -720,6 +731,10 @@ class GPipeTrainer:
             self._timings["steps_timed"] += 1
         self._step_count += 1
         self.optimizer._step_count = self._step_count
+        # deterministic preemption point (PADDLE_FAULT_SIGTERM_STEP) —
+        # the pipeline trainer is part of the kill-and-resume story too
+        from ..testing import faults as _faults
+        _faults.maybe_sigterm(self._step_count)
         return loss
 
     @property
@@ -730,7 +745,9 @@ class GPipeTrainer:
         s = {"schedule": self.schedule,
              "num_microbatches": self.num_micro,
              "pp_size": self.pp_size,
-             "peak_activation_slots": self.peak_activation_slots()}
+             "peak_activation_slots": self.peak_activation_slots(),
+             "resume_elastic": self.resume_elastic,
+             "reshard_restores": self._reshard_restores}
         for k, v in self._timings.items():
             s[k] = round(v, 3) if isinstance(v, float) else v
         res = self._comm
@@ -746,12 +763,19 @@ class GPipeTrainer:
         return s
 
     # ------------------------------------------------------------------
-    def save(self, path: str, extra=None) -> str:
-        """Checkpoint params + opt state + step (see SpmdTrainer.save)."""
+    def save(self, path: str, extra=None, manifest: bool = False) -> str:
+        """Checkpoint params + opt state + step (see SpmdTrainer.save).
+        manifest=True writes the integrity-checked directory format
+        whose v2 metadata records the pp/dp topology for elastic
+        restores."""
         from .checkpoint import save_trainer
-        return save_trainer(self, path, extra=extra)
+        return save_trainer(self, path, extra=extra, manifest=manifest)
 
     def load(self, path: str) -> dict:
+        """Restore a save() checkpoint; a checkpoint written on a
+        different (pp, dp) mesh reshards onto THIS trainer's mesh
+        (stage slabs re-split over the new pp extent) unless
+        resume_elastic=False."""
         from .checkpoint import load_trainer
         return load_trainer(self, path)
 

@@ -53,7 +53,8 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, keep_last: int = 3,
-                 async_save: bool = True, prefix: str = "ckpt-"):
+                 async_save: bool = True, prefix: str = "ckpt-",
+                 on_error=None):
         if any(directory.startswith(s) for s in ("hdfs://", "afs://")):
             raise NotImplementedError(
                 "CheckpointManager manages local directories; for "
@@ -63,10 +64,20 @@ class CheckpointManager:
         self.keep_last = max(1, int(keep_last))
         self.async_save = bool(async_save)
         self.prefix = prefix
+        # on_error(exc): invoked (on the thread that next calls save()/
+        # wait()) instead of re-raising a background commit failure —
+        # for trainers that prefer to log-and-continue.  Without it the
+        # failure RAISES at the next save()/wait(), so a dead ckpt dir
+        # can never silently discard every snapshot.
+        self.on_error = on_error
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._stuck = False   # a wait(timeout) expired on this thread
         self._saves = 0
         self._fallbacks = 0
+        self._commit_failures = 0
+        self._reshard_restores = 0
+        self.last_restore_info: Optional[dict] = None
         self.last_snapshot_ms: Optional[float] = None
         self.last_commit_ms: Optional[float] = None
 
@@ -81,6 +92,7 @@ class CheckpointManager:
         with async_save the commit happens in the background — call
         wait() (or the next save) to join it."""
         self.wait()  # serialize saves + surface any background failure
+        # (wait() refuses fast if a previous commit was declared stuck)
         if step is None:
             step = getattr(trainer, "_step_count", 0)
         path = self._path_for(step)
@@ -100,23 +112,53 @@ class CheckpointManager:
                 try:
                     commit()
                 except BaseException as e:  # surfaced by wait()
+                    self._commit_failures += 1
                     self._error = e
             self._thread = threading.Thread(
                 target=run, name="ckpt-writer", daemon=True)
             self._thread.start()
         else:
-            commit()
+            try:
+                commit()
+            except BaseException:
+                self._commit_failures += 1
+                raise
         return path
 
-    def wait(self):
-        """Join the in-flight background save; re-raise its failure."""
+    def wait(self, timeout: Optional[float] = None):
+        """Join the in-flight background save; surface its failure —
+        re-raised here, or routed to the on_error callback when one was
+        given.  With `timeout` (seconds) a commit stuck on dead storage
+        raises TimeoutError instead of hanging the trainer forever (the
+        commit thread is left running; a later wait() can still join
+        it)."""
         t = self._thread
         if t is not None:
-            t.join()
+            if timeout is None and self._stuck and t.is_alive():
+                # a previous wait(timeout) already declared this commit
+                # stuck on dead storage; an untimed join here (from
+                # save()/latest()/restore_latest()) would reintroduce
+                # the exact hang the timeout exists to prevent — refuse
+                # fast, the caller decides what to do
+                raise TimeoutError(
+                    f"previous checkpoint commit is still stuck "
+                    f"(directory {self.directory!r}); refusing an "
+                    f"untimed join behind dead storage")
+            t.join(timeout)
+            if t.is_alive():
+                self._stuck = True
+                raise TimeoutError(
+                    f"checkpoint commit still running after "
+                    f"{timeout}s (directory {self.directory!r}; slow or "
+                    f"dead storage?)")
+            self._stuck = False
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
-            raise err
+            if self.on_error is not None:
+                self.on_error(err)
+            else:
+                raise err
 
     def _candidates(self):
         """(step, path) pairs, newest first, committed finals only."""
@@ -141,10 +183,19 @@ class CheckpointManager:
         return latest_checkpoint(self.directory, prefix=self.prefix,
                                  validate=validate, gc_tmp=False)
 
-    def restore_latest(self, trainer) -> Optional[dict]:
+    def restore_latest(self, trainer,
+                       elastic: Optional[bool] = None) -> Optional[dict]:
         """Restore the newest checkpoint that validates AND unpickles,
         falling back to older ones past corruption. Returns the saved
         'extra' dict, or None when no usable checkpoint exists.
+
+        Elastic: when the candidate records a different mesh than the
+        trainer's (v2 states), the restore auto-RESHARDS onto the live
+        topology — a preempted dp=8 job resumes as dp=4 from the same
+        directory.  `elastic=False` (or resume_elastic=False on the
+        trainer) makes a cross-topology candidate an error instead; it
+        is NOT skipped as a fallback, because silently rewinding to an
+        older step over a topology policy would lose work.
 
         A structural mismatch against the live trainer (wrong model)
         still raises — that is a configuration error, not bitrot."""
@@ -160,7 +211,16 @@ class CheckpointManager:
                       f"({type(e).__name__}: {e}); falling back",
                       file=sys.stderr, flush=True)
                 continue
-            return restore_trainer(trainer, state)
+            extra = restore_trainer(trainer, state, elastic=elastic)
+            info = getattr(trainer, "_last_restore_info", None)
+            self.last_restore_info = info
+            if info and info.get("resharded"):
+                self._reshard_restores += 1
+                print(f"resilience: resharded {path} from mesh "
+                      f"{info['saved_mesh_axes']} onto "
+                      f"{info['mesh_axes']}", file=sys.stderr,
+                      flush=True)
+            return extra
         return None
 
     @property
@@ -168,6 +228,8 @@ class CheckpointManager:
         return {
             "saves": self._saves,
             "fallbacks": self._fallbacks,
+            "commit_failures": self._commit_failures,
+            "reshard_restores": self._reshard_restores,
             "async": self.async_save,
             "keep_last": self.keep_last,
             "last_snapshot_ms": self.last_snapshot_ms,

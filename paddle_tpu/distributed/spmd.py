@@ -116,13 +116,25 @@ class SpmdTrainer:
                  dp_axis: str = "dp", sp_axis: Optional[str] = None,
                  donate: bool = True,
                  anomaly_policy: Optional[str] = None,
-                 comm_stats: Optional[bool] = None):
+                 comm_stats: Optional[bool] = None,
+                 resume_elastic: Optional[bool] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
         self.strategy = strategy or DistributedStrategy()
         self.dp_axis = dp_axis
+        # elastic resume (ISSUE 10): checkpoints record their logical
+        # mesh; loading one written on a DIFFERENT topology reshards
+        # every leaf onto this trainer's mesh.  True/None allow it
+        # (None = env default), False makes a cross-topology restore an
+        # error — for jobs whose numerics must be bitwise-stable.
+        if resume_elastic is None:
+            resume_elastic = os.environ.get(
+                "PADDLE_TPU_RESUME_ELASTIC", "1") != "0"
+        self.resume_elastic = bool(resume_elastic)
+        self._reshard_restores = 0
+        self._last_restore_info: Optional[dict] = None
         # sequence-parallel axis: explicit arg > model config > "sp"
         self.sp_axis = sp_axis or getattr(
             getattr(model, "config", None), "sp_axis", None) or "sp"
@@ -1175,7 +1187,9 @@ class SpmdTrainer:
         read-backs), ``compile_ms_cold`` (first-call compile/deserialize
         cost per executable), ``steps_timed``."""
         s = {"anomaly_policy": self.anomaly_policy,
-             "rollback_steps": self._rollback_count}
+             "rollback_steps": self._rollback_count,
+             "resume_elastic": self.resume_elastic,
+             "reshard_restores": self._reshard_restores}
         t_sync = time.perf_counter()
         if self._anomaly_state is not None:
             s["skipped_steps"] = int(self._anomaly_state["skipped"])

@@ -37,7 +37,12 @@ __all__ = ["LocalFS", "HadoopFS", "get_fs", "open_for_write",
 
 
 def _fault(op: str):
-    """Fault point — no-op unless PADDLE_FAULT_FS arms it."""
+    """Fault point — no-op unless PADDLE_FAULT_FS /
+    PADDLE_FAULT_FS_DELAY_MS arms it (delay fires first: a slow THEN
+    failing store is the realistic compound fault)."""
+    if os.environ.get("PADDLE_FAULT_FS_DELAY_MS"):
+        from ..testing import faults
+        faults.maybe_delay_fs(op)
     if os.environ.get("PADDLE_FAULT_FS"):
         from ..testing import faults
         faults.maybe_fail_fs(op)

@@ -111,7 +111,8 @@ class Request:
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, eos_id, temperature, top_p):
+    def __init__(self, prompt, max_new_tokens, eos_id, temperature, top_p,
+                 deadline_s: Optional[float] = None):
         self.rid = next(Request._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -123,6 +124,14 @@ class Request:
         self.generated: List[int] = []
         self.slot: Optional[int] = None
         self.done = False
+        # per-request deadline (absolute perf_counter time): a request
+        # past it is RETIRED — slot + blocks freed, partial tokens
+        # delivered, record flagged timed_out — instead of occupying a
+        # decode slot (or the queue) forever
+        self.deadline: Optional[float] = None \
+            if deadline_s is None \
+            else time.perf_counter() + float(deadline_s)
+        self.timed_out = False
         # per-request latency accounting (stats / load harness)
         self.t_enqueue = time.perf_counter()
         self.t_admit: Optional[float] = None
@@ -264,7 +273,14 @@ class InferenceEngine:
             "decode_steps": 0, "tokens_generated": 0,
             "occupancy_sum": 0.0, "block_occupancy_sum": 0.0,
             "preemptions": 0, "memory_capped_retirements": 0,
+            "deadline_retirements": 0, "drain_forced_retirements": 0,
         }
+        # graceful drain / preemption hookup (SIGTERM'd server finishes
+        # what it started): while draining, admission is closed
+        self._draining = False
+        self._guard = None
+        self._guard_timeout: Optional[float] = None
+        self.undelivered: List[Request] = []
         self._first_call_keys: set = set()
         self._counters0 = compile_counter.snapshot()
 
@@ -408,10 +424,15 @@ class InferenceEngine:
     # ---- public API ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
                     eos_id: Optional[int] = None,
-                    temperature: float = 0.0, top_p: float = 1.0) -> int:
+                    temperature: float = 0.0, top_p: float = 1.0,
+                    deadline_s: Optional[float] = None) -> int:
         """Queue a generation request; returns its id. Admitted into a
-        free slot (dense) / free blocks (paged) at the next step()."""
-        req = Request(prompt, max_new_tokens, eos_id, temperature, top_p)
+        free slot (dense) / free blocks (paged) at the next step().
+        deadline_s (seconds from NOW, queueing included): past it the
+        request is retired with whatever it generated and reported
+        timed_out, instead of holding a decode slot forever."""
+        req = Request(prompt, max_new_tokens, eos_id, temperature, top_p,
+                      deadline_s=deadline_s)
         if req.prompt.size > self.buckets[-1]:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds the largest "
@@ -438,15 +459,23 @@ class InferenceEngine:
 
     def generate(self, prompt, max_new_tokens: int = 32,
                  eos_id: Optional[int] = None, temperature: float = 0.0,
-                 top_p: float = 1.0) -> np.ndarray:
+                 top_p: float = 1.0,
+                 deadline_s: Optional[float] = None) -> np.ndarray:
         """Blocking single-request generation THROUGH the admission
         queue: on a busy/full engine this waits for capacity (driving
         step() retires slots and frees blocks) instead of raising.
-        In-flight requests keep decoding while it waits."""
+        In-flight requests keep decoding while it waits.  With
+        deadline_s the wait is bounded: past the deadline the partial
+        generation (possibly empty) is returned."""
         rid = self.add_request(prompt, max_new_tokens=max_new_tokens,
                                eos_id=eos_id, temperature=temperature,
-                               top_p=top_p)
+                               top_p=top_p, deadline_s=deadline_s)
         while rid not in self.results:
+            if self._guard is not None and self._guard.preempted:
+                # server preempted while we were queued: drain and hand
+                # back whatever exists (empty if never admitted)
+                self.undelivered.extend(self.drain(self._guard_timeout))
+                return self.results.get(rid, np.zeros(0, np.int32))
             self.step_or_raise()
         return self.results[rid]
 
@@ -730,21 +759,26 @@ class InferenceEngine:
                 or len(req.generated) >= req.max_new_tokens or full):
             self._retire(req)
 
-    def _retire(self, req: Request):
-        req.done = True
-        req.t_finish = time.perf_counter()
-        req.active_s += req.t_finish - req.t_live
+    def _deliver(self, req: Request):
+        """The one place results/request_stats are written — every
+        finished request (normal, deadline-expired, drain-forced) goes
+        through the same bounded-history caps: a long-running server
+        must not grow state per request forever.  results is the
+        DELIVERY channel — a step()-driven server is expected to pop
+        what it consumes (loadgen does) — so its safety cap is generous
+        enough that no realistic single run() batch ever hits it."""
         self.results[req.rid] = np.asarray(req.generated, np.int32)
         self.request_stats[req.rid] = self._request_record(req)
-        # bounded history: a long-running server must not grow state
-        # per request forever.  results is the DELIVERY channel — a
-        # step()-driven server is expected to pop what it consumes
-        # (loadgen does) — so its safety cap is generous enough that
-        # no realistic single run() batch ever hits it.
         while len(self.request_stats) > self._request_stats_cap:
             self.request_stats.pop(next(iter(self.request_stats)))
         while len(self.results) > self._results_cap:
             self.results.pop(next(iter(self.results)))
+
+    def _retire(self, req: Request):
+        req.done = True
+        req.t_finish = time.perf_counter()
+        req.active_s += req.t_finish - req.t_live
+        self._deliver(req)
         self._release_slot(req)
 
     def _request_record(self, req: Request) -> dict:
@@ -752,13 +786,39 @@ class InferenceEngine:
         return {
             "prompt_tokens": int(req.prompt.size),
             "tokens": n,
-            "ttft_ms": round((req.t_first - req.t_enqueue) * 1e3, 3),
+            # a queue-expired request never produced a token: no TTFT
+            "ttft_ms": round((req.t_first - req.t_enqueue) * 1e3, 3)
+            if req.t_first is not None else None,
             "queued_ms": round(req.queued_s * 1e3, 3),
             # over ACTIVE decode time only — requeue waits excluded
             "decode_tokens_per_sec": round((n - 1) / req.active_s, 2)
             if n > 1 and req.active_s > 0 else None,
             "preemptions": req.preemptions,
+            "timed_out": req.timed_out,
         }
+
+    def _retire_expired(self):
+        """Deadline sweep (per step): queued requests past their
+        deadline are delivered empty without ever taking a slot; active
+        ones are retired mid-generation — slot and paged blocks freed —
+        with the tokens they produced so far."""
+        now = time.perf_counter()
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        for r in expired:
+            self._queue.remove(r)
+            r.timed_out = True
+            r.done = True
+            r.t_finish = now
+            r.queued_s += now - r.t_queue_since
+            self._timings["deadline_retirements"] += 1
+            self._deliver(r)
+        for req in list(self._slots):
+            if req is not None and req.deadline is not None \
+                    and now >= req.deadline:
+                req.timed_out = True
+                self._timings["deadline_retirements"] += 1
+                self._retire(req)
 
     @property
     def num_active(self) -> int:
@@ -768,12 +828,23 @@ class InferenceEngine:
     def blocks_in_use(self) -> Optional[int]:
         return self._alloc.num_in_use if self._alloc else None
 
+    @property
+    def _admitting(self) -> bool:
+        """Admission gate: closed while draining (engine.drain or a
+        fired PreemptionGuard) — in-flight slots finish, the queue
+        waits/returns."""
+        return not self._draining and (
+            self._guard is None or not self._guard.preempted)
+
     def step(self) -> int:
         """Admit queued requests into free slots, then decode one token
         for every active slot. Returns the number of tokens produced
         this step (admission prefills included)."""
         produced = 0
+        self._retire_expired()
         for slot in range(self.batch_slots):
+            if not self._admitting:
+                break
             if self._slots[slot] is not None or not self._queue:
                 continue
             # paged admission is by FREE BLOCKS, not just a free slot;
@@ -841,8 +912,17 @@ class InferenceEngine:
         progress with nothing active to retire but a non-empty queue
         can never resolve on its own.  All blocking drivers (run /
         generate / the load harness) share this one stall check."""
+        if self._guard is not None and self._guard.preempted \
+                and not self._draining:
+            # drivers that only know step_or_raise (the load harness)
+            # must not busy-spin a preempted engine forever: perform
+            # the graceful drain here — in-flight slots finish, the
+            # queue parks in undelivered, has_work goes False
+            self.undelivered.extend(self.drain(self._guard_timeout))
+            return 0
         produced = self.step()
-        if produced == 0 and self.num_active == 0 and self._queue:
+        if produced == 0 and self.num_active == 0 and self._queue \
+                and self._admitting:
             raise RuntimeError(
                 "admission stalled: queued requests but no free "
                 "capacity and nothing active to retire")
@@ -854,10 +934,53 @@ class InferenceEngine:
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive step() until every queued request finished; returns
-        {request_id: generated token ids}."""
+        {request_id: generated token ids}.  With a PreemptionGuard
+        attached, a SIGTERM mid-run switches to a graceful drain:
+        in-flight slots finish, still-queued requests land in
+        ``engine.undelivered`` for the operator to hand back."""
         while self.has_work:
+            if self._guard is not None and self._guard.preempted:
+                self.undelivered.extend(self.drain(self._guard_timeout))
+                break
             self.step_or_raise()
         return self.results
+
+    def attach_preemption_guard(self, guard,
+                                drain_timeout_s: Optional[float] = None):
+        """Hook a resilience.PreemptionGuard: once it fires (SIGTERM/
+        SIGINT), run()/generate() stop admitting, finish in-flight
+        slots (bounded by drain_timeout_s), and return — the serving
+        analogue of the trainer's drain-then-checkpoint."""
+        self._guard = guard
+        self._guard_timeout = drain_timeout_s
+        return self
+
+    def drain(self, timeout_s: Optional[float] = None) -> List[Request]:
+        """Graceful shutdown: stop admission, decode until every
+        in-flight slot retires (or timeout_s passes — stragglers are
+        then force-retired with their partial output and flagged
+        timed_out), and return the still-queued Requests so the caller
+        can re-enqueue them elsewhere.  Paged pools are verified
+        leak-free: with the slots empty and the radix cache flushed,
+        every block's refcount must be back on the free list."""
+        self._draining = True
+        t0 = time.perf_counter()
+        try:
+            while self.num_active > 0:
+                if timeout_s is not None and \
+                        time.perf_counter() - t0 > timeout_s:
+                    for req in [r for r in self._slots if r is not None]:
+                        self._timings["drain_forced_retirements"] += 1
+                        req.timed_out = True
+                        self._retire(req)
+                    break
+                self.step()
+            leftover = list(self._queue)
+            self._queue.clear()
+            self.check_leak_free()     # slots empty + queue cleared
+            return leftover
+        finally:
+            self._draining = False
 
     def flush_prefix_cache(self) -> int:
         """Drop every radix-cache node (slot-held blocks survive under
@@ -995,7 +1118,10 @@ class InferenceEngine:
         # per-request latency records, not just aggregates (satellite:
         # the load harness computes its percentiles from these)
         s["per_request"] = dict(self.request_stats)
-        ttfts = [r["ttft_ms"] for r in self.request_stats.values()]
+        # queue-expired (deadline) requests never produced a token and
+        # have no TTFT — they are counted, not averaged
+        ttfts = [r["ttft_ms"] for r in self.request_stats.values()
+                 if r["ttft_ms"] is not None]
         if ttfts:
             p50, p99 = np.percentile(ttfts, [50, 99])
             s["ttft_ms_p50"] = round(float(p50), 3)

@@ -60,7 +60,8 @@ class SharedPrefixWorkload:
 
 def run_loadtest(engine, num_requests: int, rate_rps: float,
                  workload: Optional[SharedPrefixWorkload] = None,
-                 seed: int = 0, eos_id: Optional[int] = None) -> dict:
+                 seed: int = 0, eos_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None) -> dict:
     """Open-loop Poisson load test against a warmed engine.
 
     Arrival times are drawn up front (exponential gaps at ``rate_rps``);
@@ -74,6 +75,11 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
     included — that is the point of open loop), per-request decode
     tokens/sec p50, wall-clock tokens/sec, offered vs achieved request
     rate, slot/block occupancy, prefix hit rate, and preemptions.
+
+    `deadline_s` gives every request a per-request deadline (the SLO
+    column): requests past it are retired by the engine — slot and
+    blocks freed — and counted in the report's ``timed_out_requests``
+    instead of wedging a decode slot on an overloaded server.
     """
     workload = workload or SharedPrefixWorkload(
         getattr(engine.model.cfg, "vocab_size", 1 << 15), seed=seed)
@@ -107,7 +113,8 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         truncates or accumulates over an arbitrarily long run."""
         for r in [r for r in pending if r in engine.request_stats]:
             rec = engine.request_stats.pop(r)
-            rec["ttft_ms"] = round(rec["ttft_ms"] + late_ms[r], 3)
+            if rec["ttft_ms"] is not None:
+                rec["ttft_ms"] = round(rec["ttft_ms"] + late_ms[r], 3)
             recs[r] = rec
             engine.results.pop(r, None)
             pending.discard(r)
@@ -119,7 +126,8 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         while i < len(plan) and plan[i][0] <= now:
             arrival_t, prompt, max_new = plan[i]
             rid = engine.add_request(prompt, max_new_tokens=max_new,
-                                     eos_id=eos_id)
+                                     eos_id=eos_id,
+                                     deadline_s=deadline_s)
             late_ms[rid] = max(
                 time.perf_counter() - t0 - arrival_t, 0.0) * 1e3
             rids.append(rid)
@@ -139,7 +147,7 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
     t1 = engine._timings
     steps = max(t1["decode_steps"] - t_snap["decode_steps"], 1)
     recs = [recs[r] for r in rids if r in recs]
-    ttfts = [r["ttft_ms"] for r in recs]
+    ttfts = [r["ttft_ms"] for r in recs if r["ttft_ms"] is not None]
     dtps = [r["decode_tokens_per_sec"] for r in recs
             if r["decode_tokens_per_sec"]]
     total_tokens = sum(r["tokens"] for r in recs)
@@ -161,6 +169,10 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
             (t1["occupancy_sum"] - t_snap["occupancy_sum"]) / steps, 4),
         "preemptions": (t1["preemptions"] - t_snap["preemptions"])
         if "preemptions" in t_snap else 0,
+        # SLO column: how many requests blew their per-request deadline
+        "deadline_s": deadline_s,
+        "timed_out_requests": sum(
+            1 for r in recs if r.get("timed_out")),
         "kv_layout": st["kv_layout"],
     }
     for k in ("kv_block_size", "kv_blocks_total"):

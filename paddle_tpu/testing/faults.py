@@ -24,16 +24,32 @@ PADDLE_FAULT_WORKER_KILL="w:after_n"
 PADDLE_FAULT_SIGTERM_STEP="k"
     The training process sends itself SIGTERM right after train step k
     completes — a deterministic preemption for kill-and-resume tests.
+PADDLE_FAULT_CKPT_TRUNCATE="n"
+    The nth write_checkpoint commit (1-indexed, process-local) writes a
+    TRUNCATED state payload, renames the directory into its final name,
+    and hard-exits 137 — a mid-commit kill whose partial shard LOOKS
+    committed on disk but fails manifest validation.  Exercises the
+    resume fallback walk past a corrupt newest checkpoint.
+PADDLE_FAULT_MESH_SHRINK="n"
+    create_mesh sees only the first n devices — "restore woke up on a
+    smaller topology" (the scheduler gave back fewer chips), without
+    re-execing under a different XLA device-count flag.
+PADDLE_FAULT_FS_DELAY_MS="op:ms[,op2:ms2...]"
+    Sleep ms milliseconds before each matching filesystem op ("*"
+    matches any) — deterministic slow-storage jitter for checkpoint
+    commit / delayed-write tests.  Composes with PADDLE_FAULT_FS.
 """
 from __future__ import annotations
 
 import os
 import signal
 import threading
+import time
 from typing import Optional
 
 __all__ = ["InjectedFault", "maybe_fail_fs", "nan_poison_step",
-           "maybe_kill_worker", "maybe_sigterm", "reset"]
+           "maybe_kill_worker", "maybe_sigterm", "reset",
+           "ckpt_truncate_commit", "mesh_shrink", "maybe_delay_fs"]
 
 
 class InjectedFault(IOError):
@@ -45,14 +61,16 @@ class InjectedFault(IOError):
 _lock = threading.Lock()
 _fs_counts: dict = {}
 _sigterm_fired = False
+_ckpt_commits = 0
 
 
 def reset():
     """Clear all injection counters (tests call this between cases)."""
-    global _sigterm_fired
+    global _sigterm_fired, _ckpt_commits
     with _lock:
         _fs_counts.clear()
         _sigterm_fired = False
+        _ckpt_commits = 0
 
 
 def _parse_fs_spec(spec: str):
@@ -122,6 +140,60 @@ def maybe_kill_worker(worker_id: int, batches_done: int):
         return
     if worker_id == w and batches_done >= after_n:
         os._exit(137)
+
+
+def ckpt_truncate_commit() -> bool:
+    """Fault point inside write_checkpoint: True exactly on the armed
+    nth commit of this process — the caller then commits a truncated
+    payload and hard-exits (see module docstring)."""
+    global _ckpt_commits
+    v = os.environ.get("PADDLE_FAULT_CKPT_TRUNCATE")
+    if not v:
+        return False
+    try:
+        nth = int(v)
+    except ValueError:
+        return False
+    with _lock:
+        _ckpt_commits += 1
+        return _ckpt_commits == nth
+
+
+def mesh_shrink() -> Optional[int]:
+    """Device-count clamp for create_mesh (PADDLE_FAULT_MESH_SHRINK):
+    the mesh is built from only the first n devices, simulating a
+    restore onto a smaller surviving topology."""
+    v = os.environ.get("PADDLE_FAULT_MESH_SHRINK")
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError:
+        return None
+    return n if n >= 1 else None
+
+
+def maybe_delay_fs(op: str):
+    """Delay point for filesystem operations: sleeps when
+    PADDLE_FAULT_FS_DELAY_MS arms this op (deterministic slow-storage
+    jitter; the op still succeeds)."""
+    spec = os.environ.get("PADDLE_FAULT_FS_DELAY_MS")
+    if not spec:
+        return
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        w_op, _, ms = part.partition(":")
+        if w_op != op and w_op != "*":
+            continue
+        try:
+            delay = float(ms)
+        except ValueError:
+            continue
+        if delay > 0:
+            time.sleep(delay / 1e3)
+        return
 
 
 def maybe_sigterm(step: int):

@@ -435,6 +435,127 @@ def test_beam_eos_early_exit_matches_full_run():
         assert all(t == EOS for t in row[first:])
 
 
+# ---- graceful drain + per-request deadlines (ISSUE 10 satellites) -----
+
+def test_drain_finishes_inflight_and_returns_queued(model):
+    """engine.drain(): admission stops, in-flight slots run to
+    completion, still-queued requests come back to the caller, and the
+    paged pool is verified leak-free."""
+    eng = InferenceEngine(model, batch_slots=2, prefill_buckets=[8],
+                          kv_layout="paged", kv_block_size=8)
+    rng = np.random.RandomState(3)
+    rids = [eng.add_request(rng.randint(1, 97, (5,)), max_new_tokens=6)
+            for _ in range(5)]
+    for _ in range(2):
+        eng.step()                      # two admitted, three queued
+    leftover = eng.drain()
+    assert eng.num_active == 0
+    assert len(leftover) == 3
+    assert [r.rid for r in leftover] == rids[2:]   # FIFO order kept
+    finished = [r for r in rids[:2] if r in eng.results]
+    assert len(finished) == 2
+    assert all(len(eng.results[r]) == 6 for r in finished)
+    eng.check_leak_free()               # refcounts all back in the pool
+    # the engine is usable again after the drain
+    rid = eng.add_request(rng.randint(1, 97, (5,)), max_new_tokens=2)
+    eng.run()
+    assert rid in eng.results
+
+
+def test_drain_timeout_force_retires_with_partial_output(model):
+    eng = InferenceEngine(model, batch_slots=1, prefill_buckets=[8])
+    rid = eng.add_request(np.arange(1, 6, dtype=np.int32),
+                          max_new_tokens=10_000)
+    eng.step()
+    leftover = eng.drain(timeout_s=0.0)
+    assert leftover == [] and eng.num_active == 0
+    rec = eng.request_stats[rid]
+    assert rec["timed_out"] and rec["tokens"] >= 1
+    assert eng.stats["drain_forced_retirements"] == 1
+
+
+def test_preemption_guard_drains_server(model):
+    """SIGTERM mid-run: the engine finishes what it started (in-flight
+    slots), parks the queue in engine.undelivered, and run() returns."""
+    import os
+    import signal
+
+    from paddle_tpu.distributed import PreemptionGuard
+    eng = InferenceEngine(model, batch_slots=2, prefill_buckets=[8])
+    rng = np.random.RandomState(4)
+    rids = [eng.add_request(rng.randint(1, 97, (5,)), max_new_tokens=8)
+            for _ in range(6)]
+    with PreemptionGuard() as g:
+        eng.attach_preemption_guard(g)
+        eng.step()
+        os.kill(os.getpid(), signal.SIGTERM)
+        res = eng.run()
+    assert eng.num_active == 0
+    assert len(eng.undelivered) == 4       # never admitted
+    done = [r for r in rids if r in res]
+    assert len(done) == 2 and all(len(res[r]) == 8 for r in done)
+    # a later drain ACCUMULATES into undelivered (never overwrites),
+    # and step_or_raise-only drivers (loadgen) drain instead of
+    # busy-spinning a preempted engine forever
+    with PreemptionGuard() as g2:
+        eng.attach_preemption_guard(g2)
+        late = eng.add_request(rng.randint(1, 97, (5,)),
+                               max_new_tokens=4)
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(10):
+            if not eng.has_work:
+                break
+            eng.step_or_raise()
+    assert not eng.has_work
+    assert [r.rid for r in eng.undelivered] == rids[2:] + [late]
+
+
+def test_deadline_expires_queued_and_active(model):
+    """A request past its deadline is retired — queued ones without
+    ever taking a slot, active ones mid-generation with their partial
+    tokens — and reported timed_out instead of wedging a decode slot."""
+    import time
+
+    eng = InferenceEngine(model, batch_slots=1, prefill_buckets=[8])
+    rng = np.random.RandomState(5)
+    # active past-deadline: unbounded generation, 0.15 s budget
+    r_active = eng.add_request(rng.randint(1, 97, (5,)),
+                               max_new_tokens=10_000, deadline_s=0.15)
+    # queued past-deadline: the single slot is occupied the whole time
+    r_queued = eng.add_request(rng.randint(1, 97, (5,)),
+                               max_new_tokens=4, deadline_s=0.0)
+    time.sleep(0.01)
+    while r_active not in eng.results or r_queued not in eng.results:
+        eng.step_or_raise()
+    ra, rq = eng.request_stats[r_active], eng.request_stats[r_queued]
+    assert ra["timed_out"] and 0 < ra["tokens"] < 10_000
+    assert rq["timed_out"] and rq["tokens"] == 0 \
+        and rq["ttft_ms"] is None
+    assert eng.stats["deadline_retirements"] == 2
+    assert eng.num_active == 0
+    # a deadline generous enough never fires
+    out = eng.generate(rng.randint(1, 97, (5,)), max_new_tokens=3,
+                       deadline_s=60.0)
+    assert len(out) == 3
+
+
+def test_loadtest_reports_timed_out_column(model):
+    from paddle_tpu.inference.loadgen import (SharedPrefixWorkload,
+                                              run_loadtest)
+    eng = InferenceEngine(model, batch_slots=2, prefill_buckets=[8])
+    wl = SharedPrefixWorkload(97, seed=0, shared_frac=0.0,
+                              prefix_len=4, tail_len=(3, 6),
+                              max_new=(2, 4))
+    report = run_loadtest(eng, num_requests=6, rate_rps=200.0,
+                          workload=wl, deadline_s=30.0)
+    assert report["deadline_s"] == 30.0
+    assert report["timed_out_requests"] == 0
+    report2 = run_loadtest(eng, num_requests=6, rate_rps=200.0,
+                           workload=wl, deadline_s=0.0)
+    assert report2["timed_out_requests"] == 6
+    assert report2["tokens_per_sec"] is not None
+
+
 # ---- long-sequence serve bench (slow) ---------------------------------
 
 @pytest.mark.slow

@@ -72,6 +72,110 @@ def test_launch_propagates_child_failure(tmp_path):
     assert proc.returncode == 3
 
 
+# ---- elastic membership (ISSUE 10): hosts file + shrink relaunch ------
+
+def test_read_hosts_file_and_nproc_map(tmp_path):
+    from paddle_tpu.distributed.launch import (get_cluster,
+                                               read_hosts_file)
+    hf = tmp_path / "hosts"
+    hf.write_text("# survivors after the preemption\n"
+                  "10.0.0.1:4\n"
+                  "10.0.0.2\n"
+                  "\n")
+    hosts = read_hosts_file(str(hf), default_nproc=2)
+    assert hosts == [("10.0.0.1", 4), ("10.0.0.2", 2)]
+    eps, pods = get_cluster([ip for ip, _ in hosts], 2, start_port=7000,
+                            nproc_map=dict(hosts))
+    assert len(eps) == 6                   # 4 + 2 ranks
+    assert pods[0].ranks == [0, 1, 2, 3] and pods[1].ranks == [4, 5]
+    # missing file -> None (caller falls back to --ips); an EMPTY file
+    # is an explicit zero-survivor signal ([]), not a fallback
+    assert read_hosts_file(str(tmp_path / "nope"), 2) is None
+    empty = tmp_path / "empty"
+    empty.write_text("# nothing\n")
+    assert read_hosts_file(str(empty), 2) == []
+
+
+def test_launch_elastic_shrink_relaunch(tmp_path):
+    """Crash at world=2 -> the relaunch attempt re-reads the hosts file
+    (which the dying rank shrank to 1 proc, playing the scheduler) and
+    the pod completes at the SMALLER world size instead of demanding
+    the original one back."""
+    hosts = tmp_path / "hosts"
+    hosts.write_text("127.0.0.1:2\n")
+    script = tmp_path / "train.py"
+    script.write_text(f"""
+import os, sys
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+print("WORLD", world, "RANK", rank, flush=True)
+if world == 2:
+    if rank == 0:
+        with open({str(hosts)!r}, "w") as f:
+            f.write("127.0.0.1:1\\n")   # the surviving set
+    sys.exit(9)
+sys.exit(0)
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_retries", "1",
+         "--elastic_hosts_file", str(hosts), str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "WORLD 2" in proc.stdout and "WORLD 1" in proc.stdout
+    assert "elastic restart" in proc.stderr
+
+
+def test_launch_preemption_reforms_from_survivors(tmp_path):
+    """SIGTERM on the launcher: the drain completes, and with an
+    elastic hosts file + retries left the pod RE-FORMS over the current
+    survivor set instead of exiting at the original world size."""
+    import signal
+    import time
+
+    hosts = tmp_path / "hosts"
+    hosts.write_text("127.0.0.1:2\n")
+    marker = tmp_path / "attempt2"
+    started = tmp_path / "started"
+    script = tmp_path / "serve.py"
+    script.write_text(f"""
+import os, sys, time
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+print("WORLD", world, flush=True)
+open({str(started)!r}, "a").write(str(world))
+if os.path.exists({str(marker)!r}):
+    sys.exit(0)                        # resumed attempt finishes
+time.sleep(60)                         # "training" until preempted
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_retries", "1",
+         "--elastic_hosts_file", str(hosts), str(script)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        # wait until attempt 1's ranks are actually up
+        deadline = time.time() + 30
+        while time.time() < deadline and not started.exists():
+            time.sleep(0.1)
+        assert started.exists(), "attempt 1 never started"
+        # the operator shrinks the membership, then preempts the pod
+        hosts.write_text("127.0.0.1:1\n")
+        marker.write_text("")
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (out, err)
+    assert "re-forming from the surviving host set" in err
+    assert "WORLD 1" in out
+
+
 @_needs_multiproc_backend
 def test_spawn_two_process(tmp_path):
     """paddle.distributed.spawn parity (spawn.py:276) — run via a child
