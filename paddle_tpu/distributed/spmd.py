@@ -45,11 +45,49 @@ from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
                    compile_mesh_guard)
 
 __all__ = ["SpmdTrainer", "dp_train_step", "zero_sharding_spec",
-           "build_param_specs", "StepResult"]
+           "build_param_specs", "StepResult", "tuned_remat_policy",
+           "remat_policy_key"]
 
 
 def _is_floating(a) -> bool:
     return jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def remat_policy_key(cfg):
+    """Tuning-table key for the measured remat-policy choice: the model
+    shape dims that move the save-dots-vs-full trade-off.  None when the
+    model carries no recognizable config."""
+    h = getattr(cfg, "hidden_size", None)
+    if not h:
+        return None
+    from ..utils import tuning as _tuning
+    return (_tuning.device_kind(), int(h),
+            int(getattr(cfg, "num_layers", 0) or 0),
+            int(getattr(cfg, "max_seq_len", 0) or 0))
+
+
+def tuned_remat_policy(model):
+    """The unified tuning table's measured remat policy (op
+    "remat_policy": 'dots_no_batch' / 'dots' / 'full', recorded by
+    bench.py's sweep winner) for this device + model shape — exact key
+    first, then the nearest tabled shape.  Entries recorded as
+    'off'/'none' mean the sweep's winner ran WITHOUT remat; a trainer
+    that was asked for remat ignores them (returns None).  None when
+    nothing applicable is tabled."""
+    cfg = getattr(model, "cfg", None)
+    key = remat_policy_key(cfg) if cfg is not None else None
+    if key is None:
+        return None
+    from ..utils import tuning as _tuning
+    # bounded nearest (each shape dim within ~2× overall): a policy
+    # measured on a 125m model must NOT silently drive remat for a
+    # multi-billion-param config — dots-saveable retains activations a
+    # bigger model may not have memory for
+    val = _tuning.lookup_nearest("remat_policy", key, match_idx=(0,),
+                                 near_idx=(1, 2, 3), max_dist=2.1)
+    if not isinstance(val, str) or val.lower() in ("off", "none", ""):
+        return None
+    return val
 
 
 def zero_sharding_spec(shape, base_spec: PartitionSpec, dp_axis: str,
@@ -286,13 +324,17 @@ class SpmdTrainer:
                     "enable_recompute(); wrap blocks with "
                     "paddle_tpu.distributed.recompute(...) instead")
             # honor recompute_configs['policy'] (selective save-dots etc.)
-            # defaulting to 'full' — full-segment remat, matching the
-            # reference's recompute_optimizer (benches opt into selective
-            # policies explicitly); models that predate the policy kwarg
+            # defaulting to the unified tuning table's measured winner
+            # for this (device, model shape) when one exists (bench.py
+            # records the sweep's best remat policy there), then 'full'
+            # — full-segment remat, matching the reference's
+            # recompute_optimizer; models that predate the policy kwarg
             # keep working (signature-checked, so a TypeError raised
             # INSIDE enable_recompute still propagates)
             import inspect
-            pol = st.recompute_configs.get("policy", "full")
+            pol = st.recompute_configs.get("policy")
+            if pol is None:
+                pol = tuned_remat_policy(model) or "full"
             sig = inspect.signature(model.enable_recompute)
             if "policy" in sig.parameters:
                 model.enable_recompute(policy=pol)

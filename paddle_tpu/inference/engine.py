@@ -1077,6 +1077,30 @@ class InferenceEngine:
         self.cache = cache
         return self
 
+    def _decode_hbm_bytes_per_tok(self) -> int:
+        """The decode loop's HBM read traffic per generated token, from
+        the live shapes (satellite of the megakernel ISSUE: the fused
+        kernel's saving must be a reported number, not a claim): every
+        step streams the parameters once (amortized over the
+        batch_slots tokens it produces) plus each slot's full KV extent
+        — int8-aware, counting the 8-bit values AND the f32 scale
+        planes the kernels stream alongside them."""
+        pbytes = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            pbytes += int(np.prod(leaf.shape)) * \
+                jnp.dtype(leaf.dtype).itemsize
+        cfg = self.model.cfg
+        kv_item = jnp.dtype(self.cache.k.dtype).itemsize
+        if self.kv_layout == "paged":
+            per_slot_pos = self.blocks_per_slot * self.block_size
+        else:
+            per_slot_pos = self.max_seq_len
+        kv = (2 * cfg.num_layers * per_slot_pos * cfg.num_kv_heads *
+              cfg.head_dim * kv_item)
+        if self.cache.quantized:
+            kv += 2 * cfg.num_layers * per_slot_pos * cfg.num_kv_heads * 4
+        return int(pbytes / self.batch_slots + kv)
+
     @property
     def stats(self) -> dict:
         """Cumulative serving stats (SpmdTrainer.stats convention):
@@ -1102,6 +1126,9 @@ class InferenceEngine:
         s["donate"] = self._donate
         s["kv_layout"] = self.kv_layout
         s["kv_dtype"] = self.kv_dtype or "dense"
+        from ..ops.decode_megakernel import megakernel_enabled
+        s["decode_megakernel"] = megakernel_enabled(self.model.cfg)
+        s["decode_hbm_bytes_per_tok"] = self._decode_hbm_bytes_per_tok()
         if self.kv_layout == "paged":
             s["kv_block_size"] = self.block_size
             s["kv_blocks_total"] = self._alloc.capacity

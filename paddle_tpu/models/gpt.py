@@ -135,6 +135,15 @@ class GPTConfig:
     # LM head stay full precision (the standard sensitivity split).
     # None (default) keeps every path bitwise-identical to unquantized.
     quantize: Optional[str] = None
+    # serving: fuse each layer's WHOLE decode step (attention over the
+    # KV cache + new-token fold + out proj + residual + LayerNorm + MLP)
+    # into one Pallas kernel (ops.decode_megakernel) — intermediates
+    # stay in VMEM, no HBM round-trips between sub-ops.  Off (default)
+    # keeps the composed kernels path, which remains the parity oracle;
+    # PADDLE_TPU_DECODE_MEGAKERNEL overrides at trace time.  On CPU the
+    # fused op lowers to an XLA composite that matches the composed
+    # path op for op, so the flag is safe everywhere.
+    decode_megakernel: bool = False
     tp_axis: str = "tp"
     # MoE (0 experts = dense; BASELINE.json config #5 switch-transformer)
     moe_num_experts: int = 0
@@ -672,6 +681,99 @@ class GPTBlock(Layer):
         x = x + self.mlp(self.ln_2(x))
         return x, k_layer, v_layer
 
+    # ---- fused (megakernel) decode step --------------------------------
+    def _megakernel_weights(self):
+        """The 12 per-layer arrays the fused decode step consumes, in
+        ops.decode_megakernel.LAYER_WEIGHTS order."""
+        a, m = self.attn, self.mlp
+        return tuple(t.data for t in (
+            self.ln_1.weight, self.ln_1.bias,
+            a.qkv_proj.weight, a.qkv_proj.bias,
+            a.out_proj.weight, a.out_proj.bias,
+            self.ln_2.weight, self.ln_2.bias,
+            m.up_proj.weight, m.up_proj.bias,
+            m.down_proj.weight, m.down_proj.bias))
+
+    def _megakernel_ok(self) -> bool:
+        """This block can run the fused decode step: a dense (non-MoE)
+        MLP and every projection carrying its bias."""
+        a = self.attn
+        m = self.mlp
+        if not hasattr(m, "up_proj") or not hasattr(m, "down_proj"):
+            return False
+        return not any(p is None for p in (
+            a.qkv_proj.bias, a.out_proj.bias, m.up_proj.bias,
+            m.down_proj.bias, self.ln_1.bias, self.ln_2.bias))
+
+    def forward_decode_fused(self, x, k_layer, v_layer, lengths,
+                             k_scale=None, v_scale=None):
+        """Single-token block step as ONE fused op (megakernel when the
+        backend/shape allow, the mirrored XLA composite otherwise) —
+        same signature and cache-write semantics as forward_decode, so
+        the two paths are drop-in interchangeable per layer."""
+        from ..ops import decode_megakernel as _mk
+        arr = x.data if isinstance(x, Tensor) else x      # [B, 1, H]
+        b = arr.shape[0]
+        xo, k_new, v_new = _mk.decode_layer_step(
+            arr[:, 0], self._megakernel_weights(), k_layer, v_layer,
+            lengths, k_scale, v_scale,
+            # the LIVE projection attribute, not attn.cfg: it's what
+            # enable_quantize() flips after construction
+            quantize=self.attn.qkv_proj.quantize,
+            eps=self.ln_1._epsilon)
+        cap = k_layer.shape[1]
+        idx = jnp.minimum(lengths.astype(jnp.int32), cap - 1)
+        rows = jnp.arange(b)
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_layer.dtype)
+            kq, ks = quantize_kv(k_new, mode)
+            vq, vs = quantize_kv(v_new, mode)
+            k_layer = k_layer.at[rows, idx].set(kq)
+            v_layer = v_layer.at[rows, idx].set(vq)
+            k_scale = k_scale.at[rows, idx].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[rows, idx].set(vs.astype(v_scale.dtype))
+            return (Tensor(xo[:, None]), k_layer, v_layer, k_scale,
+                    v_scale)
+        k_layer = k_layer.at[rows, idx].set(k_new.astype(k_layer.dtype))
+        v_layer = v_layer.at[rows, idx].set(v_new.astype(v_layer.dtype))
+        return Tensor(xo[:, None]), k_layer, v_layer
+
+    def forward_decode_paged_fused(self, x, k_pool, v_pool, tables,
+                                   lengths, k_scale=None, v_scale=None):
+        """Paged twin of forward_decode_fused: one fused op per layer
+        step, then the same scatter-through-the-block-table write as
+        forward_decode_paged."""
+        from ..ops import decode_megakernel as _mk
+        arr = x.data if isinstance(x, Tensor) else x      # [B, 1, H]
+        b = arr.shape[0]
+        bs = k_pool.shape[1]
+        mb = tables.shape[1]
+        xo, k_new, v_new = _mk.decode_layer_step_paged(
+            arr[:, 0], self._megakernel_weights(), k_pool, v_pool,
+            tables, lengths, k_scale, v_scale,
+            quantize=self.attn.qkv_proj.quantize,
+            eps=self.ln_1._epsilon)
+        lens = lengths.astype(jnp.int32)
+        blk_pos = jnp.minimum(lens // bs, mb - 1)
+        off = lens % bs
+        rows = jnp.arange(b)
+        blk = tables[rows, blk_pos]
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_pool.dtype)
+            kq, ks = quantize_kv(k_new, mode)
+            vq, vs = quantize_kv(v_new, mode)
+            k_pool = k_pool.at[blk, off].set(kq)
+            v_pool = v_pool.at[blk, off].set(vq)
+            k_scale = k_scale.at[blk, off].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[blk, off].set(vs.astype(v_scale.dtype))
+            return (Tensor(xo[:, None]), k_pool, v_pool, k_scale,
+                    v_scale)
+        k_pool = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
+        return Tensor(xo[:, None]), k_pool, v_pool
+
     def forward_prefill_paged(self, x, k_buf, v_buf, prefix_len):
         """Block prefill over one slot's gathered block buffer."""
         a, k_buf, v_buf = self.attn.forward_prefill_paged(
@@ -784,6 +886,37 @@ class GPTModel(Layer):
                 if lin is not None:
                     lin.quantize = mode
         return self
+
+    def enable_decode_megakernel(self, flag: bool = True):
+        """Route every serving decode step through the fused per-layer
+        megakernel (ops.decode_megakernel).  Parameters and cache
+        layouts are untouched — only the decode lowering changes — so
+        the composed path stays available as the parity oracle by
+        flipping the flag back."""
+        self.cfg = replace(self.cfg, decode_megakernel=bool(flag))
+        # blocks read their attention's cfg for quantize/epsilon only;
+        # the routing decision lives here, at the model
+        return self
+
+    def _megakernel_active(self) -> bool:
+        """The fused decode path runs for this trace: knob armed
+        (config or PADDLE_TPU_DECODE_MEGAKERNEL), homogeneous dense
+        blocks with biases, and no live tensor-parallel sharding (tp>1
+        block weights keep the composed GSPMD path)."""
+        from ..ops.decode_megakernel import megakernel_enabled
+        cfg = self.cfg
+        if not megakernel_enabled(cfg):
+            return False
+        if cfg.moe_num_experts > 0:
+            return False
+        if self.training and (cfg.dropout > 0 or cfg.attn_dropout > 0):
+            return False
+        from ..distributed.mesh import get_mesh
+        m = get_mesh()
+        if (m is not None and cfg.tp_axis in m.axis_names
+                and m.shape[cfg.tp_axis] > 1):
+            return False
+        return all(blk._megakernel_ok() for blk in self.blocks)
 
     def _zero3_mesh(self, x):
         """The compile mesh when the overlapped ZeRO-3 scan can run for
@@ -962,15 +1095,18 @@ class GPTModel(Layer):
         x = self.drop(x)
         cache_k, cache_v = cache.k, cache.v
         k_sc, v_sc = cache.k_scale, cache.v_scale
+        fused = self._megakernel_active()
         for i, blk in enumerate(self.blocks):
+            step = blk.forward_decode_fused if fused else \
+                blk.forward_decode
             if k_sc is not None:
-                x, k_layer, v_layer, ks_l, vs_l = blk.forward_decode(
+                x, k_layer, v_layer, ks_l, vs_l = step(
                     x, cache_k[i], cache_v[i], cache.lengths,
                     k_sc[i], v_sc[i])
                 k_sc = k_sc.at[i].set(ks_l)
                 v_sc = v_sc.at[i].set(vs_l)
             else:
-                x, k_layer, v_layer = blk.forward_decode(
+                x, k_layer, v_layer = step(
                     x, cache_k[i], cache_v[i], cache.lengths)
             cache_k = cache_k.at[i].set(k_layer)
             cache_v = cache_v.at[i].set(v_layer)
@@ -1077,15 +1213,18 @@ class GPTModel(Layer):
         x = self.drop(x)
         cache_k, cache_v = cache.k, cache.v
         k_sc, v_sc = cache.k_scale, cache.v_scale
+        fused = self._megakernel_active()
         for i, blk in enumerate(self.blocks):
+            step = blk.forward_decode_paged_fused if fused else \
+                blk.forward_decode_paged
             if k_sc is not None:
-                x, k_pool, v_pool, ks_p, vs_p = blk.forward_decode_paged(
+                x, k_pool, v_pool, ks_p, vs_p = step(
                     x, cache_k[i], cache_v[i], tables, lens,
                     k_sc[i], v_sc[i])
                 k_sc = k_sc.at[i].set(ks_p)
                 v_sc = v_sc.at[i].set(vs_p)
             else:
-                x, k_pool, v_pool = blk.forward_decode_paged(
+                x, k_pool, v_pool = step(
                     x, cache_k[i], cache_v[i], tables, lens)
             cache_k = cache_k.at[i].set(k_pool)
             cache_v = cache_v.at[i].set(v_pool)
@@ -1141,6 +1280,11 @@ class GPTForCausalLM(Layer):
 
     def enable_quantize(self, mode: Optional[str] = "int8"):
         self.gpt.enable_quantize(mode)
+        self.cfg = self.gpt.cfg
+        return self
+
+    def enable_decode_megakernel(self, flag: bool = True):
+        self.gpt.enable_decode_megakernel(flag)
         self.cfg = self.gpt.cfg
         return self
 

@@ -1,0 +1,666 @@
+"""Fused Pallas megakernel for ONE GPT layer decode step.
+
+The decode hot loop (inference.engine) spends each layer step on a chain
+of small ops — LayerNorm, qkv projection, cache write, fused attention,
+output projection, residual, LayerNorm, MLP up/gelu/down, residual —
+and between every pair the [B, H] activations round-trip HBM and XLA
+pays a dispatch.  Decode is bandwidth-bound: the useful bytes per layer
+step are the layer's parameters (streamed once) and the KV cache strips
+(streamed once per slot); everything else is overhead.  This module
+fuses the WHOLE layer step into one Pallas kernel — the TPU analogue of
+the reference framework fusing per-op dispatch away in its kernel layer
+(PAPER.md §1 layers 2-3):
+
+    grid (ns + 1 + nf, B)   # phases outer, slots inner
+
+    phase p == 0        ln_1(x) -> qkv projection -> split q / k_new /
+                        v_new into VMEM scratch, init online softmax
+    phase p <  ns       stream KV block p of slot b ([block_s, Hkv, D]
+                        strips; int8 blocks dequantized IN VMEM after
+                        the DMA), online-softmax update for all heads
+    phase p == ns       fold the NEW token's k/v (never written to HBM
+                        first — it lives in scratch), finalize softmax,
+                        output projection, residual, ln_2 into scratch
+    phase p >  ns       MLP tile t = p-ns-1: gelu(h2 @ up_t + b_t) @
+                        down_t accumulated in scratch; the last tile
+                        adds the residual and writes x_out / k_new /
+                        v_new back to HBM
+
+With slots innermost, a weight tile is fetched ONCE and reused by every
+slot before the phase advances, and each slot's KV blocks stream exactly
+once; the only HBM writes of the whole layer step are x_out [B, H] and
+the new token's k/v [B, Hkv, D] (the caller scatters those into the
+cache, exactly like the composed path).  All intermediates — q, the new
+k/v, the online-softmax state, the post-attention residual — live in
+VMEM scratch for the kernel's lifetime.
+
+Two layouts, mirroring ops.decode_attention:
+
+- :func:`decode_layer_step` — Static (dense) cache ``[B, cap, Hkv, D]``
+  streamed strip by strip, lengths via scalar prefetch.
+- :func:`decode_layer_step_paged` — Paged block pool
+  ``[NB, bs, Hkv, D]`` streamed through the slot's block table, the
+  same scalar-prefetch indirection as ``paged_decode_attention`` (MLP
+  phases pin the KV index map to the null block so no stray re-fetch
+  rides the weight tiles).
+
+Both accept int8 caches with per-(position, head) f32 scale strips and
+dequantize inside the block loop.  The XLA composite (`quantize=` also
+routes here — its projections then run ops.quantized_matmul with int8
+qmm tiles from the unified tuning table) reproduces the COMPOSED
+kernels path op for op, which makes the composed engine the parity
+oracle: on CPU the two lower to the same XLA ops and agree bitwise; the
+Pallas kernel is tested against it in interpret mode at 1e-5.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+import importlib
+
+# the package __init__ rebinds sibling names to public functions; fetch
+# the modules themselves (their _INTERPRET flags are live state)
+_fa = importlib.import_module(__package__ + ".flash_attention")
+_da = importlib.import_module(__package__ + ".decode_attention")
+
+__all__ = ["decode_layer_step", "decode_layer_step_paged",
+           "decode_megakernel_available", "megakernel_enabled",
+           "set_interpret_mode", "LAYER_WEIGHTS"]
+
+_NEG = -1e30
+_STATE = {"interpret": None}  # None = follow flash_attention's flag
+
+# the 12 per-layer arrays a fused step consumes, in argument order
+LAYER_WEIGHTS = ("ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_out", "b_out",
+                 "ln2_w", "ln2_b", "w_up", "b_up", "w_down", "b_down")
+
+# conservative VMEM budget for the fused kernel's resident blocks
+# (~16MB/core on v5e; leave headroom for Mosaic's own allocations and
+# double buffering of the streamed operands, which the estimate below
+# already counts at 2x)
+_VMEM_BUDGET = int(os.environ.get("PADDLE_TPU_MEGAKERNEL_VMEM",
+                                  14 * 2**20))
+
+
+def set_interpret_mode(flag):
+    """True/False force interpret mode; None follows
+    flash_attention.set_interpret_mode (one test switch for all
+    kernels)."""
+    _STATE["interpret"] = flag
+
+
+def _interpret() -> bool:
+    if _STATE["interpret"] is not None:
+        return bool(_STATE["interpret"])
+    return _fa._INTERPRET
+
+
+def decode_megakernel_available() -> bool:
+    """Pallas fused path available (needs scalar prefetch, same surface
+    as the paged decode kernel)."""
+    if not _fa._HAS_PLTPU or _fa.pltpu is None:
+        return False
+    if _interpret():
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def megakernel_enabled(cfg) -> bool:
+    """The serving knob: PADDLE_TPU_DECODE_MEGAKERNEL overrides (any
+    value but "0" arms it), else ``cfg.decode_megakernel``.  Read at
+    trace time — the engine compiles its decode executable once per
+    process, so the flag is process-stable by construction."""
+    env = os.environ.get("PADDLE_TPU_DECODE_MEGAKERNEL")
+    if env is not None:
+        return env != "0"
+    return bool(getattr(cfg, "decode_megakernel", False))
+
+
+def _pick_blocks(seq_extent: int, ffn: int):
+    """(block_s, block_f) for the KV stream / MLP tiles; env
+    PADDLE_TPU_MEGAKERNEL_BLOCKS="s,f" overrides, clamped to divide."""
+    env = os.environ.get("PADDLE_TPU_MEGAKERNEL_BLOCKS", "").strip()
+    want_s, want_f = 512, 256
+    if env:
+        try:
+            want_s, want_f = (int(x) for x in env.split(","))
+        except ValueError:
+            pass
+    return _fa._pick_block(seq_extent, want_s), _fa._pick_block(ffn, want_f)
+
+
+def _vmem_estimate(h, kvd, f, block_s, block_f, hkv, d, w_item, kv_item,
+                   quantized, batch):
+    """Rough resident-VMEM bytes: streamed operands counted at 2x
+    (double buffering), resident weights at 1x (their block index never
+    changes so Mosaic keeps one copy), plus the per-slot scratch."""
+    resident = (h * (h + 2 * kvd) + h * h + 2 * h) * w_item  # qkv+out+vecs
+    streamed = 2 * (h * block_f + block_f * h) * w_item      # up/down tiles
+    streamed += 2 * 2 * block_s * hkv * d * kv_item          # k+v strips
+    if quantized:
+        streamed += 2 * 2 * block_s * hkv * 4                # scale strips
+    scratch = batch * (3 * h + 2 * hkv * d + d * hkv * (h // (hkv * d))
+                       ) * 4 + batch * 2 * (h // d) * 128 * 4
+    return resident + streamed + scratch
+
+
+def _gelu_tanh(x):
+    # jax.nn.gelu(approximate=True): the tanh form the composed GPTMLP
+    # uses — the kernel must match it, not erf gelu
+    c = math.sqrt(2.0 / math.pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+
+
+# ---------------------------------------------------------------------------
+# the fused kernel (shared body; dense and paged differ only in how KV
+# blocks are addressed, which the BlockSpec index maps own)
+# ---------------------------------------------------------------------------
+def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
+                 wout_ref, bout_ref, ln2w_ref, ln2b_ref, wup_ref, bup_ref,
+                 wdown_ref, bdown_ref, k_ref, v_ref, ks_ref, vs_ref,
+                 xo_ref, kn_ref, vn_ref,
+                 q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr,
+                 x2_scr, h2_scr, mlp_scr,
+                 *, ns: int, nf: int, block_s: int, heads: int, hkv: int,
+                 d: int, h: int, scale: float, eps: float, cap: int,
+                 quantized: bool, paged: bool):
+    """One (phase, slot) program.  Scalar-prefetched ``len_ref`` carries
+    per-slot lengths (EXCLUDING the new token, engine convention); for
+    the paged layout the block table already acted inside the index
+    maps, so the body only sees [block_s, Hkv, D] strips either way.
+    ``ks_ref``/``vs_ref`` are the f32 scale strips of an int8 cache
+    (aliases of k_ref/v_ref in the fp path, unread)."""
+    p = pl.program_id(0)
+    b = pl.program_id(1)
+    g = heads // hkv
+    bsl = pl.ds(b, 1)
+
+    # the slot's logical write position for the new token: the composed
+    # path clamps to cap-1 (dense) so the mask must clamp identically
+    length = len_ref[b]
+    idx = jnp.minimum(length, cap - 1) if not paged else length
+
+    @pl.when(p == 0)
+    def _qkv():
+        xb = x_ref[...].astype(jnp.float32)               # [1, H]
+        mu = jnp.mean(xb, axis=-1, keepdims=True)
+        var = jnp.mean((xb - mu) ** 2, axis=-1, keepdims=True)
+        h1 = (xb - mu) * jax.lax.rsqrt(var + eps)
+        h1 = h1 * ln1w_ref[...].astype(jnp.float32) + \
+            ln1b_ref[...].astype(jnp.float32)
+        qkv = jax.lax.dot_general(
+            h1.astype(wqkv_ref.dtype), wqkv_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + \
+            bqkv_ref[...].astype(jnp.float32)             # [1, H+2KVD]
+        kvd = hkv * d
+        q_scr[bsl] = qkv[:, :h].reshape(1, heads, d)
+        kn_scr[bsl] = qkv[:, h:h + kvd].reshape(1, hkv, d)
+        vn_scr[bsl] = qkv[:, h + kvd:].reshape(1, hkv, d)
+        m_scr[bsl] = jnp.full((1,) + m_scr.shape[1:], _NEG, jnp.float32)
+        l_scr[bsl] = jnp.zeros((1,) + l_scr.shape[1:], jnp.float32)
+        acc_scr[bsl] = jnp.zeros((1, heads, d), jnp.float32)
+
+    @pl.when(p < ns)
+    def _attend():
+        q = q_scr[bsl][0]                                 # [heads, d] f32
+        pos = p * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1)
+        valid = pos < idx                                 # [1, block_s]
+        scores, vals = [], []
+        for hk in range(hkv):
+            kh = k_ref[:, hk, :]                          # [block_s, d]
+            vh = v_ref[:, hk, :]
+            if quantized:
+                kh = kh.astype(jnp.float32) * ks_ref[:, hk][:, None]
+                vh = vh.astype(jnp.float32) * vs_ref[:, hk][:, None]
+            qg = q[hk * g:(hk + 1) * g].astype(kh.dtype)  # [g, d]
+            scores.append(jax.lax.dot_general(
+                qg, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))      # [g, block_s]
+            vals.append(vh)
+        sblk = jnp.concatenate(scores, axis=0) * scale    # [heads, bs]
+        sblk = jnp.where(valid, sblk, _NEG)
+        m_prev = m_scr[bsl][0][:, :1]                     # [heads, 1]
+        l_prev = l_scr[bsl][0][:, :1]
+        acc_prev = acc_scr[bsl][0]                        # [heads, d]
+        m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+        pmat = jnp.exp(sblk - m_new)
+        pmat = jnp.where(sblk <= _NEG / 2, 0.0, pmat)     # fully masked
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(pmat, axis=1, keepdims=True)
+        accs = [jax.lax.dot_general(
+            pmat[hk * g:(hk + 1) * g].astype(vals[hk].dtype), vals[hk],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) for hk in range(hkv)]
+        acc_new = acc_prev * alpha + jnp.concatenate(accs, axis=0)
+        m_scr[bsl] = jnp.broadcast_to(m_new[None, :, :],
+                                      (1,) + m_scr.shape[1:])
+        l_scr[bsl] = jnp.broadcast_to(l_new[None, :, :],
+                                      (1,) + l_scr.shape[1:])
+        acc_scr[bsl] = acc_new[None]
+
+    @pl.when(p == ns)
+    def _finalize():
+        q = q_scr[bsl][0]                                 # [heads, d]
+        kn = kn_scr[bsl][0]                               # [hkv, d] f32
+        vn = vn_scr[bsl][0]
+        if quantized:
+            # the composed path STORES the new k/v quantized and attends
+            # the dequantized codes; reproduce that round trip exactly
+            kamax = jnp.maximum(jnp.max(jnp.abs(kn), axis=-1,
+                                        keepdims=True), 1e-8)
+            vamax = jnp.maximum(jnp.max(jnp.abs(vn), axis=-1,
+                                        keepdims=True), 1e-8)
+            ksc, vsc = kamax / 127.0, vamax / 127.0
+            kn = jnp.clip(jnp.round(kn / ksc), -127.0, 127.0) * ksc
+            vn = jnp.clip(jnp.round(vn / vsc), -127.0, 127.0) * vsc
+        kn_rep = jnp.repeat(kn, g, axis=0)                # [heads, d]
+        vn_rep = jnp.repeat(vn, g, axis=0)
+        s_new = jnp.sum(q * kn_rep, axis=-1,
+                        keepdims=True) * scale            # [heads, 1]
+        m_prev = m_scr[bsl][0][:, :1]
+        l_prev = l_scr[bsl][0][:, :1]
+        acc_prev = acc_scr[bsl][0]
+        m_new = jnp.maximum(m_prev, s_new)
+        pnew = jnp.exp(s_new - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + pnew
+        acc = acc_prev * alpha + pnew * vn_rep
+        attn = acc / jnp.maximum(l_new, 1e-30)            # [heads, d]
+        o = jax.lax.dot_general(
+            attn.reshape(1, h).astype(wout_ref.dtype), wout_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + \
+            bout_ref[...].astype(jnp.float32)             # [1, H]
+        x2 = x_ref[...].astype(jnp.float32) + o
+        mu = jnp.mean(x2, axis=-1, keepdims=True)
+        var = jnp.mean((x2 - mu) ** 2, axis=-1, keepdims=True)
+        h2 = (x2 - mu) * jax.lax.rsqrt(var + eps)
+        h2 = h2 * ln2w_ref[...].astype(jnp.float32) + \
+            ln2b_ref[...].astype(jnp.float32)
+        x2_scr[bsl] = x2[None]
+        h2_scr[bsl] = h2[None]
+        mlp_scr[bsl] = jnp.zeros((1, 1, h), jnp.float32)
+
+    @pl.when(p > ns)
+    def _mlp():
+        h2 = h2_scr[bsl][0]                               # [1, H] f32
+        u = jax.lax.dot_general(
+            h2.astype(wup_ref.dtype), wup_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + \
+            bup_ref[...].astype(jnp.float32)              # [1, block_f]
+        act = _gelu_tanh(u)
+        part = jax.lax.dot_general(
+            act.astype(wdown_ref.dtype), wdown_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [1, H]
+        mlp_scr[bsl] = mlp_scr[bsl] + part[None]
+
+    @pl.when(p == ns + nf)
+    def _write():
+        # the LAST visit of slot b's output blocks: earlier phases flush
+        # whatever the buffers held, but this write lands last and wins
+        xo_ref[...] = (x2_scr[bsl][0] + mlp_scr[bsl][0] +
+                       bdown_ref[...].astype(jnp.float32)
+                       ).astype(xo_ref.dtype)
+        kn_ref[...] = kn_scr[bsl][0].astype(kn_ref.dtype)
+        vn_ref[...] = vn_scr[bsl][0].astype(vn_ref.dtype)
+
+
+def _run_mega(x, w, k_src, v_src, ks_src, vs_src, lengths, *, ns, cap,
+              eps, quantized, paged, kv_index_map, sc_index_map,
+              extra_scalars=()):
+    """Shared pallas_call wrapper: builds grid/specs around the kernel
+    body.  ``kv_index_map``/``sc_index_map`` close over the layout
+    (dense strip walk vs paged table indirection)."""
+    pltpu = _fa.pltpu
+    (ln1_w, ln1_b, w_qkv, b_qkv, w_out, b_out,
+     ln2_w, ln2_b, w_up, b_up, w_down, b_down) = w
+    bsz, h = x.shape
+    hkv, d = k_src.shape[-2], k_src.shape[-1]
+    kvd = hkv * d
+    # q width is the qkv columns minus the two kv blocks; head count
+    # from the cache head_dim
+    heads = (w_qkv.shape[1] - 2 * kvd) // d
+    f = w_up.shape[1]
+    if paged:
+        block_s = k_src.shape[1]          # one pool block per phase
+        block_f = _pick_blocks(block_s, f)[1]
+    else:
+        block_s, block_f = _pick_blocks(k_src.shape[1], f)
+    nf = f // block_f
+    np_total = ns + 1 + nf
+    scale = 1.0 / math.sqrt(d)
+
+    def vec2(a):
+        return a.reshape(1, -1)
+
+    n_scal = 1 + len(extra_scalars)
+    # weight specs: constant-index blocks stay resident for the whole
+    # kernel; up/down tiles advance only during the MLP phases
+    def _const(shape):
+        return pl.BlockSpec(shape, lambda p, b, *s: (0,) * len(shape))
+
+    def _tile_up(p, b, *s):
+        return (0, jnp.clip(p - ns - 1, 0, nf - 1))
+
+    def _tile_down(p, b, *s):
+        return (jnp.clip(p - ns - 1, 0, nf - 1), 0)
+
+    if quantized:
+        sc_spec = pl.BlockSpec((None, block_s, hkv), sc_index_map)
+    else:
+        # unread placeholder: one block pinned at index 0, fetched once
+        sc_spec = pl.BlockSpec((None, block_s, hkv),
+                               lambda p, b, *s: (0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h), lambda p, b, *s: (b, 0)),          # x
+        _const((1, h)), _const((1, h)),                         # ln1 w/b
+        _const((h, h + 2 * kvd)), _const((1, h + 2 * kvd)),     # qkv
+        _const((h, h)), _const((1, h)),                         # out
+        _const((1, h)), _const((1, h)),                         # ln2 w/b
+        pl.BlockSpec((h, block_f), _tile_up),                   # up w
+        pl.BlockSpec((1, block_f), _tile_up),                   # up b
+        pl.BlockSpec((block_f, h), _tile_down),                 # down w
+        _const((1, h)),                                         # down b
+        pl.BlockSpec((None, block_s, hkv, d), kv_index_map),    # k
+        pl.BlockSpec((None, block_s, hkv, d), kv_index_map),    # v
+        sc_spec,                                                # k scale
+        sc_spec,                                                # v scale
+    ]
+    out_specs = [
+        pl.BlockSpec((1, h), lambda p, b, *s: (b, 0)),
+        pl.BlockSpec((None, hkv, d), lambda p, b, *s: (b, 0, 0)),
+        pl.BlockSpec((None, hkv, d), lambda p, b, *s: (b, 0, 0)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_scal,
+        grid=(np_total, bsz),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((bsz, heads, d), jnp.float32),    # q
+            pltpu.VMEM((bsz, hkv, d), jnp.float32),      # k_new
+            pltpu.VMEM((bsz, hkv, d), jnp.float32),      # v_new
+            pltpu.VMEM((bsz, heads, 128), jnp.float32),  # running max
+            pltpu.VMEM((bsz, heads, 128), jnp.float32),  # running denom
+            pltpu.VMEM((bsz, heads, d), jnp.float32),    # attn accum
+            pltpu.VMEM((bsz, 1, h), jnp.float32),        # x2 residual
+            pltpu.VMEM((bsz, 1, h), jnp.float32),        # ln2 output
+            pltpu.VMEM((bsz, 1, h), jnp.float32),        # mlp accum
+        ],
+    )
+    kernel = functools.partial(
+        _mega_kernel, ns=ns, nf=nf, block_s=block_s, heads=heads,
+        hkv=hkv, d=d, h=h, scale=scale, eps=eps, quantized=quantized,
+        paged=paged, cap=cap)
+    n_extra = len(extra_scalars)
+    if n_extra:
+        # the body only consumes lengths; extra scalar refs (the paged
+        # block table) act entirely inside the BlockSpec index maps
+        body = lambda *a: kernel(*a[n_extra:])   # noqa: E731
+    else:
+        body = kernel
+    if quantized:
+        ks_in, vs_in = (ks_src.astype(jnp.float32),
+                        vs_src.astype(jnp.float32))
+    else:
+        # unread by the kernel; one-block placeholders keep arity fixed
+        ks_in = jnp.zeros((1, block_s, hkv), jnp.float32)
+        vs_in = ks_in
+    scalars = tuple(jnp.asarray(s, jnp.int32) for s in extra_scalars) + \
+        (lengths.astype(jnp.int32),)
+    out_shapes = [
+        jax.ShapeDtypeStruct((bsz, h), x.dtype),
+        jax.ShapeDtypeStruct((bsz, hkv, d), x.dtype),
+        jax.ShapeDtypeStruct((bsz, hkv, d), x.dtype),
+    ]
+    return pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(*scalars, x, vec2(ln1_w), vec2(ln1_b), w_qkv, vec2(b_qkv),
+      w_out, vec2(b_out), vec2(ln2_w), vec2(ln2_b), w_up, vec2(b_up),
+      w_down, vec2(b_down), k_src, v_src, ks_in, vs_in)
+
+
+# ---------------------------------------------------------------------------
+# composite fallback: the composed kernels path, op for op
+# ---------------------------------------------------------------------------
+def _mm(x2, w, bias, quantize):
+    """The projection math of the composed path: F.linear, or the
+    fake-quant forward when the model trains/serves quantized (same
+    numbers as ops.quantized_matmul — int8 qmm tiles from the unified
+    tuning table when the Pallas qmm kernel engages)."""
+    if quantize:
+        from .quantized_matmul import quantized_matmul
+        y = quantized_matmul(x2, w, dtype=quantize, out_dtype=x2.dtype)
+    else:
+        y = jnp.matmul(x2, w)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _ln_f32(x2, w, bias, eps):
+    xf = x2.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    out = out * w + bias
+    return out.astype(x2.dtype)
+
+
+def _split_qkv(qkv, h, hkv, d):
+    bsz = qkv.shape[0]
+    kvd = hkv * d
+    heads = (qkv.shape[1] - 2 * kvd) // d
+    q = qkv[:, :h].reshape(bsz, heads, d)
+    k_new = qkv[:, h:h + kvd].reshape(bsz, hkv, d)
+    v_new = qkv[:, h + kvd:].reshape(bsz, hkv, d)
+    return q, k_new, v_new
+
+
+def _composite(x, w, lengths, attend, *, quantize, eps, hkv, d):
+    """Shared composite body; ``attend(q, k_new, v_new)`` runs the
+    layout's attention (dense/paged) over the cache WITH the new token
+    folded in, mirroring the composed write-then-attend order."""
+    (ln1_w, ln1_b, w_qkv, b_qkv, w_out, b_out,
+     ln2_w, ln2_b, w_up, b_up, w_down, b_down) = w
+    h = x.shape[1]
+    h1 = _ln_f32(x, ln1_w, ln1_b, eps)
+    qkv = _mm(h1, w_qkv, b_qkv, quantize)
+    q, k_new, v_new = _split_qkv(qkv, h, hkv, d)
+    attn = attend(q, k_new, v_new)                  # [B, heads, d]
+    o = _mm(attn.reshape(x.shape[0], -1).astype(x.dtype), w_out, None,
+            quantize) + b_out
+    x2 = x + o.astype(x.dtype)
+    h2 = _ln_f32(x2, ln2_w, ln2_b, eps)
+    u = _mm(h2, w_up, b_up, quantize)
+    act = jax.nn.gelu(u, approximate=True)
+    mlp = _mm(act, w_down, None, quantize) + b_down
+    x_out = x2 + mlp.astype(x.dtype)
+    return x_out, k_new, v_new
+
+
+def _dense_attend(q, k_new, v_new, k_cache, v_cache, lengths, k_scale,
+                  v_scale):
+    bsz = q.shape[0]
+    cap = k_cache.shape[1]
+    idx = jnp.minimum(lengths.astype(jnp.int32), cap - 1)
+    rows = jnp.arange(bsz)
+    if k_scale is not None:
+        from .quantized_matmul import kv_quant_mode, quantize_kv
+        mode = kv_quant_mode(k_cache.dtype)
+        kq, ks = quantize_kv(k_new, mode)
+        vq, vs = quantize_kv(v_new, mode)
+        k_eff = k_cache.at[rows, idx].set(kq)
+        v_eff = v_cache.at[rows, idx].set(vq)
+        ks_eff = k_scale.at[rows, idx].set(ks.astype(k_scale.dtype))
+        vs_eff = v_scale.at[rows, idx].set(vs.astype(v_scale.dtype))
+        return _da.decode_attention(q, k_eff, v_eff, idx + 1, ks_eff,
+                                   vs_eff)
+    k_eff = k_cache.at[rows, idx].set(k_new.astype(k_cache.dtype))
+    v_eff = v_cache.at[rows, idx].set(v_new.astype(v_cache.dtype))
+    return _da.decode_attention(q.astype(k_cache.dtype), k_eff, v_eff,
+                               idx + 1).astype(q.dtype)
+
+
+def _paged_attend(q, k_new, v_new, k_pool, v_pool, tables, lengths,
+                  k_scale, v_scale):
+    bsz = q.shape[0]
+    bs = k_pool.shape[1]
+    mb = tables.shape[1]
+    lens = lengths.astype(jnp.int32)
+    blk_pos = jnp.minimum(lens // bs, mb - 1)
+    off = lens % bs
+    rows = jnp.arange(bsz)
+    blk = tables[rows, blk_pos]
+    if k_scale is not None:
+        from .quantized_matmul import kv_quant_mode, quantize_kv
+        mode = kv_quant_mode(k_pool.dtype)
+        kq, ks = quantize_kv(k_new, mode)
+        vq, vs = quantize_kv(v_new, mode)
+        k_eff = k_pool.at[blk, off].set(kq)
+        v_eff = v_pool.at[blk, off].set(vq)
+        ks_eff = k_scale.at[blk, off].set(ks.astype(k_scale.dtype))
+        vs_eff = v_scale.at[blk, off].set(vs.astype(v_scale.dtype))
+        return _da.paged_decode_attention(q, k_eff, v_eff, tables,
+                                         lens + 1, ks_eff, vs_eff)
+    k_eff = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
+    v_eff = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
+    return _da.paged_decode_attention(
+        q.astype(k_pool.dtype), k_eff, v_eff, tables,
+        lens + 1).astype(q.dtype)
+
+
+def _fused_supported(x, w, hkv, d, block_s, quantize, kv_dtype,
+                     kv_item, quantized):
+    (ln1_w, ln1_b, w_qkv, b_qkv, w_out, b_out,
+     ln2_w, ln2_b, w_up, b_up, w_down, b_down) = w
+    h = x.shape[1]
+    f = w_up.shape[1]
+    kvd = hkv * d
+    heads = (w_qkv.shape[1] - 2 * kvd) // d
+    if quantize:
+        # quantized COMPUTE runs the composite (whose projections take
+        # the int8 qmm path with tuned tiles); the fused kernel serves
+        # the fp-compute case, with or without an int8 KV cache
+        return False
+    if quantized and kv_dtype != jnp.int8:
+        return False        # fp8 caches ride the composite
+    if heads * d != h or heads % hkv:
+        return False
+    if h % 128 or f % 128 or (d != 64 and d % 128):
+        return False
+    if block_s % 128:
+        return False
+    block_f = _pick_blocks(block_s, f)[1]
+    w_item = jnp.dtype(w_qkv.dtype).itemsize
+    est = _vmem_estimate(h, kvd, f, block_s, block_f, hkv, d, w_item,
+                         kv_item, quantized, x.shape[0])
+    if not _interpret() and est > _VMEM_BUDGET:
+        return False
+    return True
+
+
+def decode_layer_step(x, w, k_cache, v_cache, lengths, k_scale=None,
+                      v_scale=None, *, quantize=None, eps: float = 1e-5):
+    """ONE fused GPT layer decode step over a Static (dense) KV cache.
+
+    x ``[B, H]`` — the residual stream at this layer for the new token;
+    ``w`` — the 12 per-layer arrays in :data:`LAYER_WEIGHTS` order;
+    k_cache/v_cache ``[B, cap, Hkv, D]`` — the cache BEFORE the new
+    token is written (the kernel folds the new token's k/v from VMEM;
+    the CALLER scatters the returned ``k_new``/``v_new`` into the cache,
+    exactly like the composed path does); lengths ``[B]`` int32 tokens
+    already cached (excluding the new one).  int8 caches pass their
+    ``[B, cap, Hkv]`` f32 scale planes.  Returns
+    ``(x_out [B, H], k_new [B, Hkv, D] f32, v_new)``.
+
+    Pallas fused kernel when shapes/VMEM allow, XLA composite (the
+    composed kernels path op for op — the parity oracle) otherwise;
+    ``quantize`` (int8 compute) always routes the composite, whose
+    projections then run the int8 qmm kernel with tiles from the
+    unified tuning table.
+    """
+    hkv, d = k_cache.shape[2], k_cache.shape[3]
+    quantized = k_scale is not None
+    cap = k_cache.shape[1]
+    block_s = _pick_blocks(cap, w[8].shape[1])[0]
+    supported = (cap % block_s == 0 and
+                 _fused_supported(x, w, hkv, d, block_s, quantize,
+                                  k_cache.dtype,
+                                  jnp.dtype(k_cache.dtype).itemsize,
+                                  quantized))
+    if not supported or not decode_megakernel_available():
+        attend = functools.partial(_dense_attend, k_cache=k_cache,
+                                   v_cache=v_cache, lengths=lengths,
+                                   k_scale=k_scale, v_scale=v_scale)
+        return _composite(x, w, lengths, attend, quantize=quantize,
+                          eps=eps, hkv=hkv, d=d)
+    ns = cap // block_s
+
+    def kv_map(p, b, lens):
+        return (jnp.where(p < ns, b, 0), jnp.minimum(p, ns - 1), 0, 0)
+
+    def sc_map(p, b, lens):
+        return (jnp.where(p < ns, b, 0), jnp.minimum(p, ns - 1), 0)
+
+    return _run_mega(x, w, k_cache, v_cache, k_scale, v_scale, lengths,
+                     ns=ns, cap=cap, eps=eps, quantized=quantized,
+                     paged=False, kv_index_map=kv_map,
+                     sc_index_map=sc_map)
+
+
+def decode_layer_step_paged(x, w, k_pool, v_pool, tables, lengths,
+                            k_scale=None, v_scale=None, *, quantize=None,
+                            eps: float = 1e-5):
+    """ONE fused GPT layer decode step over a PAGED KV cache: the same
+    fused body as :func:`decode_layer_step`, with the slot's KV blocks
+    resolved through its scalar-prefetched block table (the
+    ``paged_decode_attention`` indirection) — MLP phases pin the index
+    map to the null block so the weight-tile phases never re-stream KV.
+    tables ``[B, MB]`` int32; lengths EXCLUDE the new token.  Returns
+    ``(x_out, k_new, v_new)`` — the caller scatters the new k/v at
+    ``(tables[b, lengths[b]//bs], lengths[b]%bs)``."""
+    hkv, d = k_pool.shape[2], k_pool.shape[3]
+    quantized = k_scale is not None
+    bs = k_pool.shape[1]
+    mb = tables.shape[1]
+    supported = _fused_supported(x, w, hkv, d, bs, quantize,
+                                 k_pool.dtype,
+                                 jnp.dtype(k_pool.dtype).itemsize,
+                                 quantized)
+    if not supported or not decode_megakernel_available():
+        attend = functools.partial(_paged_attend, k_pool=k_pool,
+                                   v_pool=v_pool, tables=tables,
+                                   lengths=lengths, k_scale=k_scale,
+                                   v_scale=v_scale)
+        return _composite(x, w, lengths, attend, quantize=quantize,
+                          eps=eps, hkv=hkv, d=d)
+
+    def kv_map(p, b, tbl, lens):
+        blk = tbl[b, jnp.minimum(p, mb - 1)]
+        return (jnp.where(p < mb, blk, 0), 0, 0, 0)
+
+    def sc_map(p, b, tbl, lens):
+        blk = tbl[b, jnp.minimum(p, mb - 1)]
+        return (jnp.where(p < mb, blk, 0), 0, 0)
+
+    return _run_mega(x, w, k_pool, v_pool, k_scale, v_scale, lengths,
+                     ns=mb, cap=mb * bs, eps=eps, quantized=quantized,
+                     paged=True, kv_index_map=kv_map, sc_index_map=sc_map,
+                     extra_scalars=(tables,))

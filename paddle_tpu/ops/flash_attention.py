@@ -460,14 +460,22 @@ def get_block_sizes(seq: int, head_dim: int, causal: bool,
             pass
     if key in _AUTOTUNE_TABLE:
         bq, bk = _AUTOTUNE_TABLE[key]
+        return _pick_block(seq, bq), _pick_block(seq, bk)
+    # nearest tabled sequence for the same (kind, head_dim, causal) —
+    # SWEPT entries (process cache / legacy file / unified tuning
+    # table, all merged by _load_sweep_store) count alongside the
+    # built-ins, so a sweep at seq 2048 serves seq 1920 too instead of
+    # dropping to the fixed defaults; swept entries come first so they
+    # win distance ties against the shipped table
+    _load_sweep_store()
+    near = [(s, v) for (k, s, d, c), v in _SWEEP_CACHE.items()
+            if k == kind and d == head_dim and c == bool(causal)]
+    near += [(s, v) for (k, s, d, c), v in _AUTOTUNE_TABLE.items()
+             if k == kind and d == head_dim and c == bool(causal)]
+    if near:
+        _, (bq, bk) = min(near, key=lambda sv: abs(sv[0] - seq))
     else:
-        # nearest tabled sequence for the same (kind, head_dim, causal)
-        near = [(s, v) for (k, s, d, c), v in _AUTOTUNE_TABLE.items()
-                if k == kind and d == head_dim and c == bool(causal)]
-        if near:
-            _, (bq, bk) = min(near, key=lambda sv: abs(sv[0] - seq))
-        else:
-            bq, bk = _DEFAULT_BLOCKS
+        bq, bk = _DEFAULT_BLOCKS
     return _pick_block(seq, bq), _pick_block(seq, bk)
 
 

@@ -221,6 +221,15 @@ def get_qmm_tiles(m: int, n: int, k: int, dtype: str = "int8"):
                 tuned = autotune_qmm_sweep(m_bucket, n, k)
         except Exception:   # sweep is best-effort; fall through
             tuned = None
+    if tuned is None:
+        # nearest tabled shape for the same (device, dtype) — a sweep
+        # at one (m, n, k) should serve its size class, not leave every
+        # off-by-a-bucket shape on hard defaults (the flash autotuner's
+        # nearest-seq behaviour); _pick_block clamps whatever comes
+        # back, so a mismatched entry can never yield an invalid grid
+        tuned = _tuning.lookup_nearest("qmm_tiles", key,
+                                       match_idx=(0, 4),
+                                       near_idx=(1, 2, 3))
     if tuned is not None:
         try:
             bm, bn, bk = (int(tuned[0]), int(tuned[1]), int(tuned[2]))

@@ -38,12 +38,14 @@ Key format on disk: ``"<op>|<part>|<part>|..."`` with parts stringified
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-__all__ = ["lookup", "record", "entries", "tuning_path", "device_kind",
-           "normalize_kind", "sweep_enabled", "key_str", "reset_for_tests"]
+__all__ = ["lookup", "lookup_nearest", "record", "entries", "tuning_path",
+           "device_kind", "normalize_kind", "sweep_enabled", "key_str",
+           "reset_for_tests"]
 
 _lock = threading.RLock()
 # op -> {key_tuple_of_strs: value}; merged from disk once, sweeps win
@@ -133,6 +135,52 @@ def lookup(op: str, parts) -> Any:
     with _lock:
         _load_once()
         return _STATE["cache"].get(op, {}).get(_key_tuple(parts))
+
+
+def lookup_nearest(op: str, parts, match_idx, near_idx,
+                   max_dist: Optional[float] = None) -> Any:
+    """The tuned value for (op, key), falling back to the NEAREST tabled
+    shape when the exact key is missing — the flash autotuner's
+    nearest-seq behaviour generalized (a sweep at seq 2048 should not
+    leave seq 1920 untuned).
+
+    Candidates must string-equal the query at every ``match_idx``
+    position (device kind, dtype, causal flag, ...); distance is the
+    summed ``|log(query/candidate)|`` ratio over the ``near_idx``
+    positions (all numeric — shape dims), so "half the size" and "twice
+    the size" are equally near.  Non-numeric candidates at a near
+    position are skipped.  ``max_dist`` caps the accepted distance —
+    callers whose tuned value changes behaviour materially (a remat
+    policy, not a tile clamp) should bound how far an entry may travel.
+    Returns the best value or None."""
+    exact = lookup(op, parts)
+    if exact is not None:
+        return exact
+    q = _key_tuple(parts)
+    best, best_d = None, None
+    with _lock:
+        _load_once()
+        table = dict(_STATE["cache"].get(op, {}))
+    for key, val in table.items():
+        if len(key) != len(q):
+            continue
+        if any(key[i] != q[i] for i in match_idx):
+            continue
+        try:
+            d = 0.0
+            for i in near_idx:
+                a, b = float(q[i]), float(key[i])
+                if a <= 0 or b <= 0:
+                    d += 0.0 if a == b else float("inf")
+                else:
+                    d += abs(math.log(a / b))
+        except ValueError:
+            continue
+        if max_dist is not None and d > max_dist:
+            continue
+        if best_d is None or d < best_d:
+            best, best_d = val, d
+    return best
 
 
 def entries(op: str) -> Dict[Tuple[str, ...], Any]:
