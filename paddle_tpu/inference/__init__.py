@@ -6,12 +6,17 @@ StableHLO export; this package adds the C ABI around it (capi/) so
 non-Python serving stacks can load the same artifact.
 """
 from ..jit.api import load as load_predictor  # noqa: F401
+from .disagg import DisaggServingEngine, PrefillWorker  # noqa: F401
 from .engine import (  # noqa: F401
     InferenceEngine, Request, default_prefill_buckets)
 from .paged_kv import (  # noqa: F401
     BlockAllocator, PagedKVCache, blocks_for, init_paged_cache)
-from .prefix_cache import RadixPrefixCache  # noqa: F401
+from .prefix_cache import RadixPrefixCache, score_overlap  # noqa: F401
+from .router import Router  # noqa: F401
+from .spec_decode import SpecDecoder  # noqa: F401
 
 __all__ = ["load_predictor", "InferenceEngine", "Request",
            "default_prefill_buckets", "PagedKVCache", "BlockAllocator",
-           "RadixPrefixCache", "blocks_for", "init_paged_cache"]
+           "RadixPrefixCache", "blocks_for", "init_paged_cache",
+           "Router", "SpecDecoder", "DisaggServingEngine",
+           "PrefillWorker", "score_overlap"]

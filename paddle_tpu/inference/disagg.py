@@ -1,0 +1,288 @@
+"""Disaggregated prefill/decode serving (DistServe-style).
+
+One engine interleaving prefill and decode has a structural tail
+problem: a long prompt's prefill runs BETWEEN decode steps, so every
+in-flight stream stalls for the whole prefill — decode p99 inflates
+with prompt length even though decode work per tick is constant.
+DistServe (Zhong et al.) splits the two phases onto separate resources:
+prefill workers chew prompts at their own pace, decode engines tick
+uninterrupted, and the KV handoff is the only coupling.
+
+The paged block pool makes that handoff nearly free: a prefill WRITES
+pool blocks, and handing the request to the decode engine is handing it
+the block ids — no KV copy, no re-compute, just refcounted pointers
+(exactly the currency the radix prefix cache already trades in).
+
+Topology here: ``DisaggServingEngine`` wraps ONE decode
+``InferenceEngine`` (paged, its admission loop bypassed) plus a
+``PrefillWorker`` holding its OWN compiled prefill executables over the
+same parameters and the same shared pool.  On CPU that is two executable
+sets interleaved on one device — the scheduling boundary the real
+deployment maps onto separate device groups (prefill mesh / decode
+mesh); the handoff protocol (blocks + first-token logits) is identical
+either way.  The decode engine's ``step()`` therefore NEVER runs a
+prefill: its step latency is pure decode, which is the p99 the loadgen
+measures.
+
+Flow per ``step()``:
+
+1. prefill phase: up to ``prefills_per_step`` queued requests run on
+   the PrefillWorker (radix-cache match -> block alloc -> suffix
+   prefill -> trim + adopt into the radix tree) and park as HANDOFF
+   records (req, blocks, logits);
+2. admission phase: free decode slots adopt parked handoffs — install
+   the block table, sample the first token from the handed-off logits
+   (``InferenceEngine.admit_handoff``);
+3. decode phase: one uninterrupted decode tick (spec decoding rides
+   along unchanged — the draft prefill is part of admission).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .engine import InferenceEngine, Request
+from .paged_kv import blocks_for
+
+__all__ = ["DisaggServingEngine", "PrefillWorker"]
+
+
+class PrefillWorker:
+    """The prefill half: its own jitted prefill executables (the
+    stand-in for a separate device group) writing into the DECODE
+    engine's shared block pool / radix cache.  Single-threaded
+    interleave — the wrapper alternates phases, so cache/alloc state
+    is never raced."""
+
+    def __init__(self, engine: InferenceEngine):
+        if engine.kv_layout != "paged":
+            raise ValueError(
+                "disaggregated prefill needs kv_layout='paged' — the "
+                "KV handoff travels through the block pool")
+        self.engine = engine
+        dargs = (1,) if engine._donate else ()
+        self._cold_jit = jax.jit(engine._prefill_paged_cold_fn,
+                                 donate_argnums=dargs)
+        self._ext_jit = jax.jit(engine._prefill_paged_ext_fn,
+                                donate_argnums=dargs)
+        self.prefills = 0
+
+    def warmup(self, buckets: Optional[List[int]] = None):
+        """Compile the worker's executables per bucket (transient pool
+        blocks, same throwaway discipline as engine.warmup)."""
+        eng = self.engine
+        for b in (buckets or eng.buckets):
+            n = blocks_for(b, eng.block_size)
+            if n > eng._alloc.capacity:
+                continue
+            blocks = eng._alloc.alloc(n)
+            assert blocks is not None, "warmup needs an empty pool"
+            row = np.zeros(eng.blocks_per_slot, np.int32)
+            row[:n] = blocks
+            ids = jnp.zeros((1, b), jnp.int32)
+            _, cache = eng._timed(
+                "prefill_ms", ("disagg", b), lambda: self._cold_jit(
+                    eng.params, eng.cache, ids, jnp.asarray(row),
+                    np.int32(1)))
+            eng.cache = cache
+            if eng._prefix is not None:
+                _, cache = eng._timed(
+                    "prefill_ms", ("disagg_ext", b), lambda: self._ext_jit(
+                        eng.params, eng.cache, ids, jnp.asarray(row),
+                        np.int32(0), np.int32(1)))
+                eng.cache = cache
+            eng._alloc.decref(blocks)
+        return self
+
+    def try_prefill(self, req: Request):
+        """Run one request's prefill; returns the handoff record
+        ``(req, blocks, logits)`` or None when the pool cannot hold it
+        yet (caller leaves it queued — head-of-line FIFO, same policy
+        as engine admission).  The match/alloc/shed/trim/adopt sequence
+        is ``engine._paged_prefill`` — ONE implementation shared with
+        in-engine admission, run here on the WORKER's executables."""
+        rec = self.engine._paged_prefill(req, self._cold_jit,
+                                         self._ext_jit, "disagg")
+        if rec is None:
+            return None
+        blocks, _plen, logits = rec
+        self.prefills += 1
+        return req, blocks, logits
+
+
+class DisaggServingEngine:
+    """Prefill/decode-disaggregated serving: duck-types the
+    ``InferenceEngine`` driving surface (add_request / step /
+    step_or_raise / has_work / run / drain / results / stats), so the
+    load harness and router treat it as just another replica."""
+
+    def __init__(self, model, prefills_per_step: int = 1,
+                 handoff_depth: int = 4, **engine_kw):
+        engine_kw.setdefault("kv_layout", "paged")
+        self.decode = InferenceEngine(model, **engine_kw)
+        self.worker = PrefillWorker(self.decode)
+        self.prefills_per_step = int(prefills_per_step)
+        self.handoff_depth = int(handoff_depth)
+        self._queue: deque = deque()
+        self._handoffs: deque = deque()
+        self.handoffs_total = 0
+
+    # ---- delegated surface --------------------------------------------
+    @property
+    def model(self):
+        return self.decode.model
+
+    @property
+    def results(self) -> Dict[int, np.ndarray]:
+        return self.decode.results
+
+    @property
+    def request_stats(self) -> Dict[int, dict]:
+        return self.decode.request_stats
+
+    @property
+    def _timings(self):
+        return self.decode._timings
+
+    @property
+    def _prefix(self):
+        return self.decode._prefix
+
+    @property
+    def kv_layout(self):
+        return self.decode.kv_layout
+
+    @property
+    def batch_slots(self):
+        return self.decode.batch_slots
+
+    @property
+    def num_active(self) -> int:
+        return self.decode.num_active
+
+    def prefix_summary(self):
+        return self.decode.prefix_summary()
+
+    def warmup(self, buckets: Optional[List[int]] = None):
+        self.decode.warmup(buckets)
+        self.worker.warmup(buckets or self.decode.buckets)
+        return self
+
+    def add_request(self, prompt, **kw) -> int:
+        """Queue on the WRAPPER (the decode engine's own queue stays
+        empty — its admission loop never runs a prefill).  Validation
+        rides the engine's add_request, then the request is lifted out."""
+        rid = self.decode.add_request(prompt, **kw)
+        req = self.decode._queue.pop()
+        self._queue.append(req)
+        return rid
+
+    # ---- the disaggregated step ---------------------------------------
+    def _reclaim_preempted(self):
+        """A decode-side preemption parks its victim on the DECODE
+        engine's queue; pull it back so its resume prefill runs on the
+        worker, keeping the decode path prefill-free."""
+        if self.decode._queue:
+            self._queue = deque(list(self.decode._queue) +
+                                list(self._queue))
+            self.decode._queue.clear()
+
+    def _expire_queued(self):
+        now = time.perf_counter()
+        for r in [r for r in self._queue
+                  if r.deadline is not None and now >= r.deadline]:
+            self._queue.remove(r)
+            self.decode.expire_queued_request(r, now)
+
+    def step(self) -> int:
+        """One disaggregated round: prefill phase -> handoff admission
+        -> ONE pure decode tick."""
+        produced = 0
+        self._reclaim_preempted()
+        self._expire_queued()
+        # 1) prefill phase (bounded: parked handoffs hold pool blocks)
+        done = 0
+        while (self._queue and done < self.prefills_per_step
+               and len(self._handoffs) < self.handoff_depth
+               and self.decode._admitting):
+            rec = self.worker.try_prefill(self._queue[0])
+            if rec is None:
+                break                     # pool full; head-of-line waits
+            self._queue.popleft()
+            self._handoffs.append(rec)
+            self.handoffs_total += 1
+            done += 1
+        # 2) admission: free slots adopt parked handoffs
+        for slot in range(self.decode.batch_slots):
+            if not self._handoffs or not self.decode._admitting:
+                break
+            if self.decode._slots[slot] is None:
+                req, blocks, logits = self._handoffs.popleft()
+                self.decode.admit_handoff(req, slot, blocks, logits)
+                produced += 1
+        # 3) pure decode tick
+        produced += self.decode.step()
+        return produced
+
+    def step_or_raise(self) -> int:
+        produced = self.step()
+        if (produced == 0 and self.decode.num_active == 0
+                and not self._handoffs and self._queue
+                and self.decode._admitting):
+            raise RuntimeError(
+                "admission stalled: queued requests but the prefill "
+                "worker cannot place them and nothing active to retire")
+        return produced
+
+    @property
+    def has_work(self) -> bool:
+        return (bool(self._queue) or bool(self._handoffs)
+                or self.decode.has_work)
+
+    def run(self) -> Dict[int, np.ndarray]:
+        while self.has_work:
+            self.step_or_raise()
+        return self.decode.results
+
+    def generate(self, prompt, **kw) -> np.ndarray:
+        rid = self.add_request(prompt, **kw)
+        while rid not in self.decode.results:
+            self.step_or_raise()
+        return self.decode.results[rid]
+
+    def _release_handoffs(self) -> List[Request]:
+        """Return parked handoffs' blocks to the pool and their
+        requests to the caller (drain path)."""
+        out = []
+        while self._handoffs:
+            req, blocks, _ = self._handoffs.popleft()
+            self.decode._alloc.decref(blocks)
+            out.append(req)
+        return out
+
+    def drain(self, timeout_s: Optional[float] = None) -> List[Request]:
+        leftover = list(self._queue)
+        self._queue.clear()
+        leftover = self._release_handoffs() + leftover
+        leftover = self.decode.drain(timeout_s) + leftover
+        return leftover
+
+    def check_leak_free(self):
+        assert not self._handoffs, \
+            "leak check requires drained handoffs"
+        self.decode.check_leak_free()
+
+    @property
+    def stats(self) -> dict:
+        s = self.decode.stats
+        s["disaggregated"] = True
+        s["prefill_worker_prefills"] = self.worker.prefills
+        s["handoffs"] = self.handoffs_total
+        s["handoff_queue"] = len(self._handoffs)
+        return s

@@ -181,7 +181,8 @@ class InferenceEngine:
                  kv_block_size: Optional[int] = None,
                  kv_num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 spec_k: Optional[int] = None, draft_model=None):
         model.eval()
         self.model = model
         cfg = model.cfg
@@ -251,6 +252,24 @@ class InferenceEngine:
             self._decode_paged_fn, donate_argnums=dargs)
         self._sample_jit = jax.jit(self._sample_from_logits)
 
+        # speculative decoding (inference.spec_decode): a draft model +
+        # K>0 replace the single-token decode step with a propose/verify
+        # tick committing ~K+1 tokens per host sync.  Greedy only — the
+        # acceptance rule is the temperature-0 rejection rule, so output
+        # is token-identical to the non-speculative rollout.
+        from .spec_decode import SpecDecoder, resolve_spec_k
+        sk = resolve_spec_k(spec_k)
+        self._spec = None
+        if sk > 0:
+            if draft_model is None:
+                raise ValueError(
+                    "spec_k/PADDLE_TPU_SPEC_K set but no draft_model "
+                    "given — speculation needs a draft (the target "
+                    "model itself is a valid, if pointless-on-paper, "
+                    "draft for harnesses)")
+            self._spec = SpecDecoder(self, draft_model, sk)
+        self.spec_k = self._spec.k if self._spec else 0
+
         self._key = jax.random.PRNGKey(int(seed))
 
         # scheduler state
@@ -274,6 +293,8 @@ class InferenceEngine:
             "occupancy_sum": 0.0, "block_occupancy_sum": 0.0,
             "preemptions": 0, "memory_capped_retirements": 0,
             "deadline_retirements": 0, "drain_forced_retirements": 0,
+            "spec_ticks": 0, "spec_tokens_committed": 0,
+            "spec_slot_ticks": 0, "spec_capacity_retirements": 0,
         }
         # graceful drain / preemption hookup (SIGTERM'd server finishes
         # what it started): while draining, admission is closed
@@ -433,6 +454,11 @@ class InferenceEngine:
         timed_out, instead of holding a decode slot forever."""
         req = Request(prompt, max_new_tokens, eos_id, temperature, top_p,
                       deadline_s=deadline_s)
+        if self._spec is not None and req.temperature > 0:
+            raise ValueError(
+                "speculative decoding serves greedy requests only "
+                "(the acceptance rule is the temperature-0 rejection "
+                "rule); run a non-spec engine for sampled traffic")
         if req.prompt.size > self.buckets[-1]:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds the largest "
@@ -447,8 +473,11 @@ class InferenceEngine:
             bs = self.block_size
             worst = max(
                 blocks_for(self._bucket_for(req.prompt.size), bs),
-                blocks_for(min(req.prompt.size + req.max_new_tokens,
-                               self.max_seq_len), bs))
+                # spec ticks write a K+1 window before the scheduler
+                # knows how much of it commits, so the steady-state
+                # extent carries that margin
+                blocks_for(min(req.prompt.size + req.max_new_tokens
+                               + self.spec_k, self.max_seq_len), bs))
             if worst > self._alloc.capacity:
                 raise ValueError(
                     f"request needs {worst} KV blocks but the pool only "
@@ -512,6 +541,8 @@ class InferenceEngine:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._top_ps[slot] = 1.0
+        if self._spec is not None:
+            self._spec.on_release(slot)
         req.slot = None
 
     def _preempt(self, req: Request):
@@ -592,6 +623,10 @@ class InferenceEngine:
         req.generated.append(tok)
         self._next_token[slot] = tok
         self._retire_if_done(req, tok)
+        if self._spec is not None and self._slots[slot] is req:
+            # the draft prefills the same (full) prompt and the first
+            # sampled token seeds its catch-up window
+            self._spec.on_admit(req, slot, tok)
 
     def _admit_dense(self, req: Request, slot: int):
         prompt = req.effective_prompt()
@@ -609,10 +644,29 @@ class InferenceEngine:
         self._record_admission(req, slot, plen, logits)
 
     def _admit_paged(self, req: Request, slot: int) -> bool:
-        """Paged admission: match the radix cache, allocate blocks for
-        the divergent suffix's bucket, prefill ONLY the suffix, then
-        trim the bucket-padding blocks and adopt the prompt into the
-        radix tree."""
+        """Paged admission: one in-engine prefill, then the same slot
+        adoption a disaggregated handoff uses."""
+        rec = self._paged_prefill(req, self._prefill_paged_cold_jit,
+                                  self._prefill_paged_ext_jit,
+                                  "prefill_paged")
+        if rec is None:
+            return False                      # stay queued; retry later
+        blocks, _plen, logits = rec
+        self.admit_handoff(req, slot, blocks, logits)
+        return True
+
+    def _paged_prefill(self, req: Request, cold_jit, ext_jit,
+                       key_prefix: str):
+        """The paged prefill body: match the radix cache, allocate
+        blocks for the divergent suffix's bucket, prefill ONLY the
+        suffix, then trim the bucket-padding blocks and adopt the
+        prompt into the radix tree.  Returns ``(blocks, plen, logits)``
+        with the slot-lifetime refcounts TAKEN (the caller installs the
+        block table and finishes admission), or None when the pool
+        cannot hold the request yet.  Parameterized over the compiled
+        executables so the in-engine admission path and the
+        disaggregated PrefillWorker (its own executables = its own
+        device group) share one implementation."""
         bs = self.block_size
         prompt = req.effective_prompt()
         pc_stats0 = None
@@ -663,7 +717,7 @@ class InferenceEngine:
             if pc_stats0 is not None:
                 (self._prefix.queries, self._prefix.hit_queries,
                  self._prefix.hit_blocks) = pc_stats0
-            return False                      # stay queued; retry later
+            return None                       # stay queued; retry later
         blocks = list(shared) + new_blocks
         req.t_admit = time.perf_counter()
         # the prefix-cache win in one number: a hit admission prefills
@@ -676,14 +730,14 @@ class InferenceEngine:
         row[:len(blocks)] = blocks
         if prefix_len == 0:
             logits, cache = self._timed(
-                "prefill_ms", ("prefill_paged", bucket),
-                lambda: self._prefill_paged_cold_jit(
+                "prefill_ms", (key_prefix, bucket),
+                lambda: cold_jit(
                     self.params, self.cache, jnp.asarray(ids),
                     jnp.asarray(row), np.int32(suffix.size)))
         else:
             logits, cache = self._timed(
-                "prefill_ms", ("prefill_paged_ext", bucket),
-                lambda: self._prefill_paged_ext_jit(
+                "prefill_ms", (key_prefix + "_ext", bucket),
+                lambda: ext_jit(
                     self.params, self.cache, jnp.asarray(ids),
                     jnp.asarray(row), np.int32(prefix_len),
                     np.int32(suffix.size)))
@@ -696,9 +750,6 @@ class InferenceEngine:
         if len(blocks) > keep:
             self._alloc.decref(blocks[keep:])
             blocks = blocks[:keep]
-        self._slot_blocks[slot] = blocks
-        self._tables[slot, :] = 0
-        self._tables[slot, :len(blocks)] = blocks
         # adopt the prompt's full blocks into the radix tree so the NEXT
         # request sharing this prefix skips its prefill
         if self._prefix is not None:
@@ -706,50 +757,65 @@ class InferenceEngine:
             if n_full:
                 self._prefix.insert(prompt[:n_full * bs],
                                     blocks[:n_full])
-        self._record_admission(req, slot, plen, logits)
-        return True
+        return blocks, plen, logits
 
-    def _ensure_decode_room(self):
-        """Before a decode step every active slot whose next write falls
-        past its block extent gets one fresh block — by free list, then
-        radix-cache eviction, then preemption of the youngest other
-        request.  This is the no-deadlock path ISSUE'd as
-        preempt-to-queue: the dense engine could never run out mid-
-        request, the paged one can."""
+    def admit_handoff(self, req: Request, slot: int, blocks, logits):
+        """Adopt a request whose prefill ALREADY ran elsewhere (the
+        disaggregated prefill worker — inference.disagg): install its
+        block table and finish admission from the handed-off last-token
+        logits.  The blocks arrive trimmed, radix-adopted and owned by
+        this slot (the worker took the slot's refcounts); no prefill
+        executable runs on the decode side — that is the point."""
+        if self.kv_layout != "paged":
+            raise ValueError("admit_handoff needs the paged layout — "
+                             "the KV handoff travels through the block "
+                             "pool")
+        plen = int(req.effective_prompt().size)
+        self._slot_blocks[slot] = list(blocks)
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        self._record_admission(req, slot, plen, logits)
+
+    def _ensure_decode_room(self, need_tokens: int = 1):
+        """Before a decode step every active slot whose next
+        ``need_tokens`` writes would fall past its block extent gets
+        fresh blocks — by free list, then radix-cache eviction, then
+        preemption of the youngest other request.  This is the
+        no-deadlock path ISSUE'd as preempt-to-queue: the dense engine
+        could never run out mid-request, the paged one can.
+        ``need_tokens`` > 1 is the spec-decode tick, which scatters a
+        K+1-token window before knowing how much of it commits."""
         for slot in range(self.batch_slots):
             req = self._slots[slot]
             if req is None:
                 continue
-            extent = len(self._slot_blocks[slot]) * self.block_size
-            if int(self._slot_len[slot]) < extent:
-                continue
-            nb = self._alloc_blocks(1)
-            if nb is None:
-                nb = self._preempt_for_blocks(1, exclude=req)
-            if nb is None:
-                # every OTHER active request has outgrown the largest
-                # bucket (un-resumable victims — possible with custom
-                # coarse bucket lists): degrade the requester, never
-                # the engine.  Preempt it if it can itself resume;
-                # otherwise retire it with the tokens it has (a
-                # memory-capped finish beats killing every request).
-                total = len(req.prompt) + len(req.generated)
-                if (total <= self.buckets[-1] and blocks_for(
-                        self._bucket_for(total), self.block_size)
-                        <= self._alloc.capacity):
-                    self._preempt(req)
-                else:
-                    self._timings["memory_capped_retirements"] += 1
-                    self._retire(req)
-                continue
-            if self._slots[slot] is None:
-                # the victim hunt preempted ... ourselves?  impossible
-                # (exclude=req), but keep the invariant obvious
-                self._alloc.decref(nb)
-                continue
-            idx = len(self._slot_blocks[slot])
-            self._slot_blocks[slot].append(nb[0])
-            self._tables[slot, idx] = nb[0]
+            need_blocks = blocks_for(
+                int(self._slot_len[slot]) + need_tokens, self.block_size)
+            while (self._slots[slot] is req
+                   and len(self._slot_blocks[slot]) < need_blocks):
+                nb = self._alloc_blocks(1)
+                if nb is None:
+                    nb = self._preempt_for_blocks(1, exclude=req)
+                if nb is None:
+                    # every OTHER active request has outgrown the
+                    # largest bucket (un-resumable victims — possible
+                    # with custom coarse bucket lists): degrade the
+                    # requester, never the engine.  Preempt it if it
+                    # can itself resume; otherwise retire it with the
+                    # tokens it has (a memory-capped finish beats
+                    # killing every request).
+                    total = len(req.prompt) + len(req.generated)
+                    if (total <= self.buckets[-1] and blocks_for(
+                            self._bucket_for(total), self.block_size)
+                            <= self._alloc.capacity):
+                        self._preempt(req)
+                    else:
+                        self._timings["memory_capped_retirements"] += 1
+                        self._retire(req)
+                    break
+                idx = len(self._slot_blocks[slot])
+                self._slot_blocks[slot].append(nb[0])
+                self._tables[slot, idx] = nb[0]
 
     def _retire_if_done(self, req: Request, last_tok: int):
         """EOS / max-new-tokens / capacity retirement; frees the slot
@@ -797,6 +863,18 @@ class InferenceEngine:
             "timed_out": req.timed_out,
         }
 
+    def expire_queued_request(self, req: Request, now: float):
+        """Deliver a QUEUED request as deadline-expired (it never took
+        a slot, so there is nothing to free) — the one place this
+        bookkeeping lives; the engine's own sweep and the disaggregated
+        wrapper's queue both route here."""
+        req.timed_out = True
+        req.done = True
+        req.t_finish = now
+        req.queued_s += now - req.t_queue_since
+        self._timings["deadline_retirements"] += 1
+        self._deliver(req)
+
     def _retire_expired(self):
         """Deadline sweep (per step): queued requests past their
         deadline are delivered empty without ever taking a slot; active
@@ -807,12 +885,7 @@ class InferenceEngine:
                    if r.deadline is not None and now >= r.deadline]
         for r in expired:
             self._queue.remove(r)
-            r.timed_out = True
-            r.done = True
-            r.t_finish = now
-            r.queued_s += now - r.t_queue_since
-            self._timings["deadline_retirements"] += 1
-            self._deliver(r)
+            self.expire_queued_request(r, now)
         for req in list(self._slots):
             if req is not None and req.deadline is not None \
                     and now >= req.deadline:
@@ -857,6 +930,8 @@ class InferenceEngine:
             [1 if r is not None else 0 for r in self._slots], np.int32)
         if not active_np.any():
             return produced
+        if self._spec is not None:
+            return produced + self._step_spec()
         if self.kv_layout == "paged":
             self._ensure_decode_room()
             # a preemption/memory-capped retirement may have emptied
@@ -905,6 +980,76 @@ class InferenceEngine:
             produced += 1
             self._timings["tokens_generated"] += 1
             self._retire_if_done(req, tok)
+        return produced
+
+    def _step_spec(self) -> int:
+        """One speculative tick for every active slot: draft proposes
+        K, target verifies K+1 in one executable, the scheduler commits
+        the accepted prefix + bonus token.  Still exactly ONE host sync
+        — it just pays for ~K+1 tokens now."""
+        k = self._spec.k
+        # capacity: a slot without room for the whole K+1 window
+        # retires now (the window writes at slot_len..slot_len+K).
+        # NB: this is up to K tokens EARLIER than a non-spec engine
+        # would stop — the token-identity contract therefore requires
+        # prompt + max_new + K <= max_seq (counted below so a
+        # mis-sized deployment shows up in stats, not in silence)
+        for req in list(self._slots):
+            if req is not None and int(self._slot_len[req.slot]) + k + 1 \
+                    > self.max_seq_len:
+                self._timings["spec_capacity_retirements"] += 1
+                self._retire(req)
+        if self.kv_layout == "paged":
+            self._ensure_decode_room(need_tokens=k + 1)
+        active_np = np.asarray(
+            [1 if r is not None else 0 for r in self._slots], np.int32)
+        if not active_np.any():
+            return 0
+        if self.kv_layout == "paged":
+            self._timings["block_occupancy_sum"] += \
+                self._alloc.num_in_use / self._alloc.capacity
+        self._timings["occupancy_sum"] += float(active_np.mean())
+        out = self._spec.tick(active_np)
+        # the ONE host sync of the tick: K+1 target-greedy tokens + the
+        # committed count per slot, one int32 readback
+        t0 = time.perf_counter()
+        out_np = np.asarray(out)
+        async_dispatch.record_host_sync()
+        self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
+        self._timings["decode_steps"] += 1
+        self._timings["spec_ticks"] += 1
+        self._timings["spec_slot_ticks"] += int(active_np.sum())
+        produced = 0
+        for slot, req in enumerate(list(self._slots)):
+            if req is None:
+                continue
+            n_emit = int(out_np[slot, k + 1])
+            toks = out_np[slot, :k + 1]
+            # host mirrors the in-graph length advance (dense) / owns
+            # it (paged); EOS/max-new truncation below RETIRES the
+            # slot, so the un-truncated advance never leaks into a
+            # later tick
+            self._slot_len[slot] += n_emit
+            emitted = []
+            retired = False
+            for i in range(n_emit):
+                tok = int(toks[i])
+                req.generated.append(tok)
+                emitted.append(tok)
+                produced += 1
+                self._timings["tokens_generated"] += 1
+                if tok == req.eos_id or \
+                        len(req.generated) >= req.max_new_tokens:
+                    retired = True
+                    self._retire(req)
+                    break
+            # count what actually reached the stream — an EOS/max-new
+            # truncation must not inflate accepted_tokens_per_tick
+            self._timings["spec_tokens_committed"] += len(emitted)
+            if not retired and emitted:
+                self._next_token[slot] = emitted[-1]
+                self._spec.after_commit(slot,
+                                        np.asarray(emitted, np.int32))
         return produced
 
     def step_or_raise(self) -> int:
@@ -982,6 +1127,13 @@ class InferenceEngine:
         finally:
             self._draining = False
 
+    def prefix_summary(self) -> Optional[dict]:
+        """The radix cache's router-facing digest (block-granular
+        fingerprint set + hit/evict counters), or None when this engine
+        runs without a prefix cache.  Cheap: the fingerprint set is
+        maintained incrementally, no tree walk happens here."""
+        return self._prefix.summary() if self._prefix is not None else None
+
     def flush_prefix_cache(self) -> int:
         """Drop every radix-cache node (slot-held blocks survive under
         the slots' own references). Returns blocks released."""
@@ -1006,7 +1158,16 @@ class InferenceEngine:
         assert self.num_active == 0 and not self._queue, \
             "warmup() must run before traffic"
         if self.kv_layout == "paged":
-            return self._warmup_paged(buckets)
+            self._warmup_paged(buckets)
+        else:
+            self._warmup_dense(buckets)
+        if self._spec is not None:
+            # draft prefill per bucket + the spec tick executable; both
+            # caches' lengths are zeroed afterwards (inside)
+            self._spec.warmup()
+        return self
+
+    def _warmup_dense(self, buckets):
         for b in (buckets or [self.buckets[0]]):
             ids = jnp.zeros((1, b), jnp.int32)
             logits, cache = self._timed(
@@ -1129,6 +1290,30 @@ class InferenceEngine:
         from ..ops.decode_megakernel import megakernel_enabled
         s["decode_megakernel"] = megakernel_enabled(self.model.cfg)
         s["decode_hbm_bytes_per_tok"] = self._decode_hbm_bytes_per_tok()
+        if self._spec is not None:
+            s["spec_k"] = self._spec.k
+            # per (tick × active slot): 1.0 is what plain decode pays a
+            # host sync for, K+1 is the ceiling
+            ticks = t["spec_slot_ticks"]
+            per_tick = t["spec_tokens_committed"] / ticks if ticks else 0.0
+            s["accepted_tokens_per_tick"] = round(per_tick, 3)
+            s["spec_acceptance_rate"] = round(
+                (t["spec_tokens_committed"] - ticks)
+                / max(ticks * self._spec.k, 1), 4)
+            if ticks:
+                # one tick streams the target once (the window pass is
+                # byte-wise one decode step) + the draft ~K times, and
+                # emits per_tick tokens: the amortized read traffic is
+                # the number the ISSUE wants to see drop
+                s["decode_hbm_bytes_per_tok"] = int(
+                    (s["decode_hbm_bytes_per_tok"]
+                     + self._spec.k * self._spec.step_hbm_bytes())
+                    / max(per_tick, 1.0))
+        else:
+            s.pop("spec_ticks", None)
+            s.pop("spec_tokens_committed", None)
+            s.pop("spec_slot_ticks", None)
+            s.pop("spec_capacity_retirements", None)
         if self.kv_layout == "paged":
             s["kv_block_size"] = self.block_size
             s["kv_blocks_total"] = self._alloc.capacity
@@ -1137,6 +1322,11 @@ class InferenceEngine:
                 t["block_occupancy_sum"] / steps, 4)
             if self._prefix is not None:
                 s.update(self._prefix.stats)
+                # the router-facing digest, JSON-safe (fingerprints as a
+                # count; the raw set rides prefix_summary())
+                s["prefix_cache"] = {
+                    k: (len(v) if k == "fingerprints" else v)
+                    for k, v in self._prefix.summary().items()}
             s.pop("block_occupancy_sum", None)    # internal accumulator
         else:
             s.pop("block_occupancy_sum", None)

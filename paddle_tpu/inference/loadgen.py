@@ -26,7 +26,8 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["SharedPrefixWorkload", "run_loadtest"]
+__all__ = ["SharedPrefixWorkload", "MultiTenantWorkload", "run_loadtest",
+           "run_fleet_loadtest"]
 
 
 class SharedPrefixWorkload:
@@ -188,4 +189,230 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         report["prefix_queries"] = dq
         report["prefix_hit_rate"] = round(dh / dq, 4) if dq else 0.0
         report["prefix_hit_blocks"] = pc.hit_blocks - pc_snap[2]
+    return report
+
+
+class MultiTenantWorkload:
+    """Skewed multi-tenant traffic: ``num_tenants`` tenants, each with
+    its OWN system prefix, arriving with Zipf-ish weights
+    (``1/rank^skew``) — a few hot tenants dominate, a long tail of cold
+    ones trickles.  This is the workload where a prefix-aware router
+    earns its keep: routing a hot tenant's requests to the replica
+    already holding its prefix turns N replicas into N *sharded*
+    caches instead of N redundant cold ones."""
+
+    def __init__(self, vocab_size: int, seed: int = 0,
+                 num_tenants: int = 8, skew: float = 1.2,
+                 prefix_len: int = 16, tail_len=(3, 12), max_new=(4, 12)):
+        self._rng = np.random.RandomState(seed)
+        self.vocab = int(vocab_size)
+        self.tail_len = tail_len
+        self.max_new = max_new
+        self.prefixes = [
+            self._rng.randint(1, self.vocab,
+                              (int(prefix_len),)).astype(np.int32)
+            for _ in range(int(num_tenants))]
+        w = 1.0 / np.arange(1, num_tenants + 1) ** float(skew)
+        self.weights = w / w.sum()
+
+    def sample(self):
+        """Returns (tenant id, prompt ids, max_new_tokens)."""
+        rng = self._rng
+        tenant = int(rng.choice(len(self.prefixes), p=self.weights))
+        tail = rng.randint(1, self.vocab, (rng.randint(
+            self.tail_len[0], self.tail_len[1] + 1),)).astype(np.int32)
+        prompt = np.concatenate([self.prefixes[tenant], tail])
+        return tenant, prompt, int(rng.randint(self.max_new[0],
+                                               self.max_new[1] + 1))
+
+
+def warm_fleet(router, workload, passes: int = 2):
+    """Steady-state warmup: run every tenant's prefix through the
+    router (closed loop, `passes` rounds) so the measured window that
+    follows describes the fleet's STEADY behavior, not its cold start
+    — first-touch prefix misses are unavoidable under any policy and
+    land here for all of them.  Under a prefix-aware policy this also
+    settles each tenant onto its home replica."""
+    for _ in range(int(passes)):
+        for prefix in workload.prefixes:
+            # the prefix itself: admission adopts its full blocks into
+            # the radix tree, which is all a later match() consults
+            router.add_request(prefix, max_new_tokens=1)
+    router.run()
+    # consume the warmup traffic's records so the measured window's
+    # bookkeeping starts clean
+    for r in router.replicas:
+        r.results.clear()
+        r.request_stats.clear()
+
+
+def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
+                       workload: Optional[MultiTenantWorkload] = None,
+                       seed: int = 0, eos_id: Optional[int] = None,
+                       deadline_s: Optional[float] = None) -> dict:
+    """Open-loop Poisson load test against a ROUTED fleet (a
+    ``router.Router`` over warmed replicas) — the multi-replica twin of
+    :func:`run_loadtest`.  Requests arrive on the Poisson clock, the
+    router places each one (by prefix overlap, load, or round-robin —
+    its policy), and every replica with work advances each drive round.
+
+    The report adds the fleet columns the single-engine harness cannot
+    have: per-replica request counts and slot occupancy, the ROUTER hit
+    rate (how often cache affinity made the placement), the aggregate
+    radix-cache hit rate across replicas (the number cache-aware
+    routing is supposed to move), and accepted_tokens_per_tick when the
+    replicas decode speculatively.
+
+    Each replica runs on its OWN driver thread (the router only places
+    requests; it never serializes the fleet): a replica's prefill work
+    delays ITS streams, not the whole fleet — which is both how a real
+    deployment behaves and what makes routing quality visible in the
+    TTFT tail.  Engines stay single-threaded internally (one driver
+    thread each; the main thread only enqueues and reads finished
+    records)."""
+    replicas = router.replicas
+    workload = workload or MultiTenantWorkload(
+        getattr(replicas[0].model.cfg, "vocab_size", 1 << 15), seed=seed)
+    t_snaps = [dict(r._timings) for r in replicas]
+    pcs = [r._prefix for r in replicas]
+    pc_snaps = [(pc.queries, pc.hit_queries, pc.hit_blocks)
+                if pc is not None else None for pc in pcs]
+    # router counters are router-LIFETIME (warm_fleet routes traffic
+    # through them too): snapshot so the report describes THIS window
+    rt_snap = (router.requests, router.prefix_routed, list(router.routed))
+    rng = np.random.RandomState(seed + 1)
+    gaps = rng.exponential(1.0 / float(rate_rps), size=int(num_requests))
+    arrivals = np.cumsum(gaps)
+    plan = [(t,) + workload.sample() for t in arrivals]
+
+    pending = {}                  # (ridx, rid) -> arrival lateness ms
+    order: List[tuple] = []
+    recs = {}
+    tenants = {}
+
+    def _drain():
+        for key in [k for k in pending if k[1] in
+                    replicas[k[0]].request_stats]:
+            ridx, rid = key
+            rec = replicas[ridx].request_stats.pop(rid)
+            if rec["ttft_ms"] is not None:
+                rec["ttft_ms"] = round(rec["ttft_ms"] + pending[key], 3)
+            rec["replica"] = ridx
+            recs[key] = rec
+            replicas[ridx].results.pop(rid, None)
+            del pending[key]
+
+    import threading
+    stop = threading.Event()
+    errors: List[BaseException] = []
+    # engines are single-threaded by contract; the harness provides the
+    # exclusion: each replica's step and its admissions share one lock
+    # (a step's queue sweep iterates the deque an arrival would mutate)
+    locks = {id(r): threading.Lock() for r in replicas}
+
+    def _drive(replica):
+        # one thread per replica: step while there is work, otherwise
+        # yield — mirrors N independent serving processes
+        lock = locks[id(replica)]
+        try:
+            while not stop.is_set():
+                if replica.has_work:
+                    with lock:
+                        replica.step_or_raise()
+                else:
+                    time.sleep(0.001)
+        except BaseException as e:  # surface replica crashes to caller
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=_drive, args=(r,), daemon=True)
+               for r in replicas]
+    for th in threads:
+        th.start()
+    i = 0
+    try:
+        while i < len(plan) or router.has_work or pending:
+            if errors:
+                raise errors[0]
+            now = time.perf_counter() - t0
+            while i < len(plan) and plan[i][0] <= now:
+                arrival_t, tenant, prompt, max_new = plan[i]
+                # route outside the lock (reads only), enqueue inside
+                ridx = router.route(prompt)
+                with locks[id(replicas[ridx])]:
+                    rid = replicas[ridx].add_request(
+                        prompt, max_new_tokens=max_new, eos_id=eos_id,
+                        deadline_s=deadline_s)
+                late = max(time.perf_counter() - t0 - arrival_t,
+                           0.0) * 1e3
+                pending[(ridx, rid)] = late
+                order.append((ridx, rid))
+                tenants[(ridx, rid)] = tenant
+                i += 1
+            _drain()
+            if i < len(plan):
+                time.sleep(min(max(plan[i][0] - now, 0.0), 0.005))
+            else:
+                time.sleep(0.001)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10.0)
+    _drain()
+    wall_s = time.perf_counter() - t0
+
+    recs_l = [recs[k] for k in order if k in recs]
+    ttfts = [r["ttft_ms"] for r in recs_l if r["ttft_ms"] is not None]
+    total_tokens = sum(r["tokens"] for r in recs_l)
+    # per-replica occupancy + aggregate prefix hit rate over THIS window
+    occ = []
+    steps_total = 0
+    preemptions = 0
+    pq = ph = 0
+    spec_committed = spec_slot_ticks = 0
+    for r, snap, pc, pcs0 in zip(replicas, t_snaps, pcs, pc_snaps):
+        t1 = r._timings
+        steps = max(t1["decode_steps"] - snap["decode_steps"], 1)
+        steps_total += t1["decode_steps"] - snap["decode_steps"]
+        occ.append(round(
+            (t1["occupancy_sum"] - snap["occupancy_sum"]) / steps, 4))
+        preemptions += t1.get("preemptions", 0) - snap.get("preemptions",
+                                                           0)
+        spec_committed += t1["spec_tokens_committed"] - \
+            snap["spec_tokens_committed"]
+        spec_slot_ticks += t1["spec_slot_ticks"] - snap["spec_slot_ticks"]
+        if pcs0 is not None:
+            pq += pc.queries - pcs0[0]
+            ph += pc.hit_queries - pcs0[1]
+    report = {
+        "num_requests": len(recs_l),
+        "num_replicas": len(replicas),
+        "policy": router.policy,
+        "offered_rps": round(float(rate_rps), 3),
+        "achieved_rps": round(len(recs_l) / wall_s, 3) if wall_s else None,
+        "wall_s": round(wall_s, 3),
+        "tokens_generated": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall_s, 2)
+        if wall_s else None,
+        "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3)
+        if ttfts else None,
+        "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3)
+        if ttfts else None,
+        "replica_occupancy": occ,
+        "requests_per_replica": [n - n0 for n, n0 in
+                                 zip(router.routed, rt_snap[2])],
+        "router_hit_rate": round(
+            (router.prefix_routed - rt_snap[1]) /
+            max(router.requests - rt_snap[0], 1), 4),
+        "prefix_queries": pq,
+        "prefix_hit_rate": round(ph / pq, 4) if pq else 0.0,
+        "preemptions": preemptions,
+        "deadline_s": deadline_s,
+        "timed_out_requests": sum(1 for r in recs_l if r.get("timed_out")),
+        "decode_steps": steps_total,
+        "tenants_seen": len(set(tenants.values())),
+    }
+    if spec_slot_ticks:
+        report["accepted_tokens_per_tick"] = round(
+            spec_committed / spec_slot_ticks, 3)
     return report

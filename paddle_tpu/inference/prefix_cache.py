@@ -33,11 +33,48 @@ from __future__ import annotations
 import itertools
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["RadixPrefixCache"]
+__all__ = ["RadixPrefixCache", "fingerprint_chain", "path_fingerprint",
+           "score_overlap"]
+
+
+def fingerprint_chain(tokens, block_size: int):
+    """The rolling path fingerprints of ``tokens``'s full-block prefix
+    chunks (capped at len-1, mirroring ``match()`` — at least one token
+    is always left to prefill).  Depends only on (tokens, block_size),
+    so a router scoring N replicas computes it ONCE and intersects each
+    replica's fingerprint set against it."""
+    bs = int(block_size)
+    toks = [int(t) for t in tokens]
+    usable = (len(toks) - 1) // bs
+    chain = []
+    h = 0
+    for i in range(usable):
+        h = path_fingerprint(h, tuple(toks[i * bs:(i + 1) * bs]))
+        chain.append(h)
+    return chain
+
+
+def score_overlap(tokens, summary: dict, chain=None) -> int:
+    """Blocks of ``tokens``'s prefix present in a replica ``summary()``
+    digest: consecutive fingerprint-chain matches from the root — the
+    score equals the block count match() would return on that replica.
+    ``chain`` short-circuits the rolling hash with a precomputed
+    ``fingerprint_chain(tokens, summary['block_size'])`` (the router
+    scores N replicas against one prompt)."""
+    fps = summary["fingerprints"]
+    if chain is None:
+        chain = fingerprint_chain(tokens, summary["block_size"])
+    score = 0
+    for h in chain:
+        if h not in fps:
+            break
+        score += 1
+    return score
 
 
 class _Node:
-    __slots__ = ("key", "block", "children", "parent", "last_used")
+    __slots__ = ("key", "block", "children", "parent", "last_used",
+                 "path_hash")
 
     def __init__(self, key: Optional[tuple], block: Optional[int],
                  parent: Optional["_Node"]):
@@ -46,6 +83,18 @@ class _Node:
         self.parent = parent
         self.children: Dict[tuple, "_Node"] = {}
         self.last_used = 0
+        # rolling hash of the root->node chunk path (see path_fingerprint):
+        # what the router matches against without ever seeing the tree
+        self.path_hash = 0
+
+
+def path_fingerprint(parent_hash: int, chunk: tuple) -> int:
+    """Rolling fingerprint of a chunk path: hash of (parent fingerprint,
+    chunk).  Stable within a process (tuple/int hashing), cheap to roll
+    forward token-block by token-block — the router recomputes it over
+    an incoming prompt and intersects with replica summaries, so two
+    sides agree on 'same prefix' iff the chunk paths are equal."""
+    return hash((parent_hash, chunk))
 
 
 class RadixPrefixCache:
@@ -64,6 +113,11 @@ class RadixPrefixCache:
         self._root = _Node(None, None, None)
         self._nodes = 0
         self._clock = itertools.count(1)
+        # block-granular fingerprint index: the path hash of every live
+        # node, maintained INCREMENTALLY on insert/evict so summary()
+        # never walks the tree (it sits on the router's per-request
+        # scoring path)
+        self._fingerprints: set = set()
         # stats the engine/load harness report
         self.queries = 0
         self.hit_queries = 0
@@ -124,8 +178,10 @@ class RadixPrefixCache:
             child = node.children.get(chunk)
             if child is None:
                 child = _Node(chunk, int(block), node)
+                child.path_hash = path_fingerprint(node.path_hash, chunk)
                 node.children[chunk] = child
                 self._alloc.incref([int(block)])
+                self._fingerprints.add(child.path_hash)
                 self._nodes += 1
                 adopted += 1
             child.last_used = tick
@@ -146,6 +202,7 @@ class RadixPrefixCache:
     def _drop(self, node: _Node) -> None:
         node.parent.children.pop(node.key, None)
         self._alloc.decref([node.block])
+        self._fingerprints.discard(node.path_hash)
         self._nodes -= 1
         self.evicted_blocks += 1
 
@@ -195,6 +252,24 @@ class RadixPrefixCache:
             self._drop(n)
             dropped += 1
         return dropped
+
+    # ---- router-facing summary ----------------------------------------
+    def summary(self) -> dict:
+        """Cheap per-replica digest for cache-aware routing: the
+        block-granular fingerprint set (path hashes of every cached
+        chunk path — maintained incrementally, O(1) to hand out) plus
+        hit/evict counters.  A router scores an incoming prompt by
+        rolling :func:`path_fingerprint` over its chunks and counting
+        how many consecutive hashes live in ``fingerprints`` — prefix
+        overlap without ever walking this replica's tree."""
+        return {
+            "block_size": self.block_size,
+            "fingerprints": self._fingerprints,
+            "cached_blocks": self._nodes,
+            "hit_queries": self.hit_queries,
+            "queries": self.queries,
+            "evicted_blocks": self.evicted_blocks,
+        }
 
     # ---- stats --------------------------------------------------------
     @property

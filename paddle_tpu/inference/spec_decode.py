@@ -1,0 +1,324 @@
+"""Speculative decoding: a small draft GPT proposes, the target verifies.
+
+Leviathan et al., *Fast Inference from Transformers via Speculative
+Decoding*: decode is bandwidth-bound — every single-token step streams
+the whole model + KV cache through the chip to emit ONE token.  A small
+draft model can propose K tokens cheaply; the target model then scores
+all K+1 positions in ONE windowed forward (the multi-token variant of
+``ops.decode_attention`` — same bytes streamed as a single decode step)
+and keeps the longest prefix of proposals that match its own greedy
+choices, plus one bonus token from its own logits.  Greedy acceptance is
+the standard rejection rule at temperature 0, so the emitted stream is
+TOKEN-IDENTICAL to the target-only rollout — speculation changes the
+schedule, never the text.  With an agreeable draft, each tick emits
+~K+1 tokens for one target pass + one host sync, and the decode loop's
+HBM bytes per emitted token drop proportionally.
+
+Mechanics per tick (ONE fixed-shape jitted call — the zero-recompile
+contract of the engine survives):
+
+1. **Draft catch-up**: the tokens the scheduler committed last tick that
+   the draft has not processed (1..2 of them — the bonus token, plus the
+   last proposal when everything was accepted) ride in as a fixed
+   ``[B, K+1]`` window; a windowed draft forward folds them into the
+   draft's own StaticKVCache and its last valid logit row proposes
+   draft token 1.
+2. **Propose**: K-1 single-token draft decode steps propose the rest.
+3. **Verify**: the target runs ONE windowed forward over
+   ``[last_committed, d_1..d_K]`` — writing all K+1 k/v into its cache
+   in-graph (dense scatter or paged block-table scatter) — and takes
+   greedy ``g_0..g_K``.
+4. **Accept**: ``n_acc = longest prefix with d_i == g_{i-1}``; commit
+   ``g_0..g_{n_acc}`` (the standard rule: every accepted draft plus one
+   bonus token).  Cache lengths advance by the committed count
+   in-graph; rejected positions hold garbage ABOVE the advanced length
+   — the masked-garbage convention every decode path here already uses
+   — and are overwritten by the next tick's window.
+
+The draft always rides a dense StaticKVCache (it is small; block
+accounting for it would buy nothing); the TARGET cache is whatever the
+engine runs — dense or paged, fp or int8 — which is the matrix the
+tests pin down.  ``PADDLE_TPU_SPEC_K`` arms it engine-wide; greedy
+sampling only (the rejection rule below IS temperature 0 — sampled
+speculation needs the full rejection-sampling residual, a follow-up).
+
+Capacity caveat: a tick writes its whole K+1 window before knowing how
+much commits, so a stream retires once ``len + K + 1`` would pass
+``max_seq_len`` — up to K tokens earlier than a non-speculative
+engine.  Token identity therefore holds whenever
+``prompt + max_new + K <= max_seq`` (the sane deployment shape);
+streams cut by the window margin are counted in
+``stats['spec_capacity_retirements']``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..func import functional_apply, functional_state
+from ..models.gpt import StaticKVCache
+
+__all__ = ["SpecDecoder", "resolve_spec_k"]
+
+
+def resolve_spec_k(spec_k: Optional[int]) -> int:
+    """Draft window size: explicit arg, else PADDLE_TPU_SPEC_K, else 0
+    (speculation off)."""
+    if spec_k is not None:
+        return int(spec_k)
+    return int(os.environ.get("PADDLE_TPU_SPEC_K", 0) or 0)
+
+
+class SpecDecoder:
+    """The engine's speculative-decoding half: owns the draft model's
+    params + dense KV cache and the compiled tick executables.
+
+    The ENGINE stays the scheduler — admission, EOS/deadline retirement,
+    preemption and block accounting are untouched; this class only
+    replaces the one-token decode step with the K+1-token tick and
+    keeps the per-slot catch-up window (`win`/`nprev`) that makes the
+    draft cache converge to the committed stream.
+    """
+
+    def __init__(self, engine, draft_model, k: int):
+        if k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        draft_model.eval()
+        dcfg = draft_model.cfg
+        tcfg = engine.model.cfg
+        if dcfg.vocab_size != tcfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dcfg.vocab_size} != target vocab "
+                f"{tcfg.vocab_size}")
+        if dcfg.max_seq_len < engine.max_seq_len:
+            raise ValueError(
+                f"draft max_seq_len {dcfg.max_seq_len} < engine "
+                f"max_seq_len {engine.max_seq_len} — the draft must "
+                f"reach every position the target serves")
+        self.engine = engine
+        self.k = int(k)
+        self.draft = draft_model
+        self.draft_params, _ = functional_state(draft_model)
+        # the draft rides a DENSE static cache regardless of the
+        # target's layout: per-slot lengths live in-graph (advanced by
+        # the tick itself, including the rollback of rejected
+        # proposals), so the host never tracks draft state
+        self.draft_cache = draft_model.init_kv_cache(
+            engine.batch_slots, engine.max_seq_len)
+        # per-slot catch-up window: committed tokens the draft has not
+        # seen yet (1 after a fresh admission — the first sampled
+        # token; up to 2 mid-stream)
+        kp1 = self.k + 1
+        self.win = np.zeros((engine.batch_slots, kp1), np.int32)
+        self.nprev = np.ones(engine.batch_slots, np.int32)
+        dargs = (2, 3) if engine._donate else ()
+        self._tick_dense_jit = jax.jit(self._tick_dense_fn,
+                                       donate_argnums=dargs)
+        self._tick_paged_jit = jax.jit(self._tick_paged_fn,
+                                       donate_argnums=dargs)
+        self._draft_prefill_jit = jax.jit(
+            self._draft_prefill_fn,
+            donate_argnums=(1,) if engine._donate else ())
+
+    # ---- compiled functions -------------------------------------------
+    def _draft_prefill_fn(self, params, cache, ids, slot, prompt_len):
+        return functional_apply(self.draft, "prefill", params, ids,
+                                cache, slot, prompt_len)
+
+    def _draft_propose(self, d_params, d_cache, last_win, nprev, active):
+        """Catch-up window + K-1 single-token steps -> K greedy draft
+        proposals.  Returns (drafts [B, K], d_cache) with the draft
+        cache advanced past everything it processed (catch-up tokens
+        AND proposals — the tick rolls rejected proposals back)."""
+        b = last_win.shape[0]
+        logits_d, d_cache = functional_apply(
+            self.draft, "verify_step", d_params, last_win, d_cache)
+        # advance the draft past the nprev real catch-up tokens
+        d_cache = StaticKVCache(
+            d_cache.k, d_cache.v,
+            d_cache.lengths + nprev.astype(jnp.int32) * active,
+            d_cache.k_scale, d_cache.v_scale)
+        idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
+        last_logits = jnp.take_along_axis(
+            logits_d, idx[:, None, None], axis=1)[:, 0]    # [B, V]
+        d_prev = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        drafts = [d_prev]
+        for _ in range(self.k - 1):
+            lg, d_cache = functional_apply(
+                self.draft, "decode_step", d_params, d_prev, d_cache,
+                active)
+            d_prev = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            drafts.append(d_prev)
+        return jnp.stack(drafts, axis=1), d_cache          # [B, K]
+
+    def _accept(self, drafts, logits_t, active):
+        """The greedy rejection rule.  logits_t [B, K+1, V] — target
+        logits over [last_committed, d_1..d_K].  Returns
+        (g [B, K+1] — the target-greedy tokens, n_emit [B] — committed
+        count = accepted drafts + 1 bonus, masked by active)."""
+        g = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        match = (drafts == g[:, :self.k]).astype(jnp.int32)
+        acc = jnp.cumprod(match, axis=1)       # accepted-prefix mask
+        n_acc = jnp.sum(acc, axis=1)
+        n_emit = (n_acc + 1) * active.astype(jnp.int32)
+        return g, n_acc, n_emit
+
+    def _draft_rollback(self, d_cache, n_acc, active):
+        """Proposals past the accepted prefix are NOT part of the
+        committed stream: roll the draft's in-graph lengths back over
+        them (their k/v become masked garbage, overwritten by the next
+        catch-up window).  Proposal d_K was never fed back, so the
+        overshoot is K-1 - n_acc, floored at 0."""
+        overshoot = jnp.maximum(self.k - 1 - n_acc, 0) * \
+            active.astype(jnp.int32)
+        return StaticKVCache(d_cache.k, d_cache.v,
+                             d_cache.lengths - overshoot,
+                             d_cache.k_scale, d_cache.v_scale)
+
+    def _tick_dense_fn(self, t_params, d_params, t_cache, d_cache,
+                       last_win, nprev, active):
+        """One dense-target spec tick; returns (out [B, K+2] int32 —
+        the K+1 target-greedy tokens + the committed count, ONE host
+        readback — t_cache, d_cache)."""
+        drafts, d_cache = self._draft_propose(d_params, d_cache,
+                                              last_win, nprev, active)
+        idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
+        t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
+        window = jnp.concatenate([t0, drafts], axis=1)     # [B, K+1]
+        logits_t, t_cache = functional_apply(
+            self.engine.model, "verify_step", t_params, window, t_cache)
+        g, n_acc, n_emit = self._accept(drafts, logits_t, active)
+        t_cache = StaticKVCache(
+            t_cache.k, t_cache.v,
+            jnp.minimum(t_cache.lengths + n_emit, t_cache.capacity),
+            t_cache.k_scale, t_cache.v_scale)
+        d_cache = self._draft_rollback(d_cache, n_acc, active)
+        out = jnp.concatenate([g, n_emit[:, None]], axis=1)
+        return out, t_cache, d_cache
+
+    def _tick_paged_fn(self, t_params, d_params, t_cache, d_cache,
+                       last_win, nprev, active, tables, t_lens):
+        """Paged-target spec tick: identical flow with the target's
+        window scattered through the block tables; target lengths are
+        HOST state (the scheduler advances them from the readback)."""
+        drafts, d_cache = self._draft_propose(d_params, d_cache,
+                                              last_win, nprev, active)
+        idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
+        t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
+        window = jnp.concatenate([t0, drafts], axis=1)
+        logits_t, t_cache = functional_apply(
+            self.engine.model, "verify_step_paged", t_params, window,
+            t_cache, tables, t_lens)
+        g, n_acc, n_emit = self._accept(drafts, logits_t, active)
+        d_cache = self._draft_rollback(d_cache, n_acc, active)
+        out = jnp.concatenate([g, n_emit[:, None]], axis=1)
+        return out, t_cache, d_cache
+
+    # ---- host-side hooks the engine calls -----------------------------
+    def on_admit(self, req, slot: int, first_tok: int):
+        """A request just prefilled into `slot` on the TARGET: prefill
+        the draft over the same (full) prompt and seed the catch-up
+        window with the first sampled token."""
+        eng = self.engine
+        prompt = req.effective_prompt()
+        bucket = eng._bucket_for(prompt.size)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :prompt.size] = prompt
+        _, cache = eng._timed(
+            "prefill_ms", ("draft_prefill", bucket),
+            lambda: self._draft_prefill_jit(
+                self.draft_params, self.draft_cache, jnp.asarray(ids),
+                np.int32(slot), np.int32(prompt.size)))
+        self.draft_cache = cache
+        self.win[slot, :] = 0
+        self.win[slot, 0] = first_tok
+        self.nprev[slot] = 1
+
+    def on_release(self, slot: int):
+        """Slot retired/preempted: neutralize its spec state (the draft
+        cache row resets at the next admission's prefill)."""
+        self.win[slot, :] = 0
+        self.nprev[slot] = 1
+
+    def after_commit(self, slot: int, emitted: np.ndarray):
+        """The scheduler committed `emitted` tokens for `slot` this
+        tick: queue the suffix the draft has not processed as the next
+        catch-up window.  The draft HAS the accepted proposals it fed
+        itself (min(n_acc, K-1) of them); it lacks the bonus token and,
+        when everything was accepted, the never-fed d_K."""
+        n_emit = len(emitted)
+        in_cache = min(n_emit - 1, self.k - 1)
+        tail = emitted[in_cache:]
+        self.win[slot, :] = 0
+        self.win[slot, :len(tail)] = tail
+        self.nprev[slot] = len(tail)
+
+    def tick(self, active: np.ndarray):
+        """Run one spec tick over the current slots; returns the host
+        readback ``out [B, K+2]`` (K+1 target-greedy tokens + committed
+        count per slot)."""
+        eng = self.engine
+        if eng.kv_layout == "paged":
+            out, t_cache, d_cache = eng._timed(
+                "decode_ms", ("spec_tick", 0),
+                lambda: self._tick_paged_jit(
+                    eng.params, self.draft_params, eng.cache,
+                    self.draft_cache, jnp.asarray(self.win),
+                    jnp.asarray(self.nprev), jnp.asarray(active),
+                    jnp.asarray(eng._tables),
+                    jnp.asarray(eng._slot_len.astype(np.int32))))
+        else:
+            out, t_cache, d_cache = eng._timed(
+                "decode_ms", ("spec_tick", 0),
+                lambda: self._tick_dense_jit(
+                    eng.params, self.draft_params, eng.cache,
+                    self.draft_cache, jnp.asarray(self.win),
+                    jnp.asarray(self.nprev), jnp.asarray(active)))
+        eng.cache = t_cache
+        self.draft_cache = d_cache
+        return out
+
+    def step_hbm_bytes(self) -> int:
+        """One draft decode step's HBM read traffic (params amortized
+        over the batch + the dense draft KV extent) — the spec-adjusted
+        decode_hbm_bytes_per_tok accounting in engine.stats."""
+        pbytes = 0
+        for leaf in jax.tree_util.tree_leaves(self.draft_params):
+            pbytes += int(np.prod(leaf.shape)) * \
+                jnp.dtype(leaf.dtype).itemsize
+        dcfg = self.draft.cfg
+        eng = self.engine
+        kv_item = jnp.dtype(self.draft_cache.k.dtype).itemsize
+        kv = (2 * dcfg.num_layers * eng.max_seq_len *
+              dcfg.num_kv_heads * dcfg.head_dim * kv_item)
+        return int(pbytes / eng.batch_slots + kv)
+
+    def warmup(self):
+        """Compile the tick executable (and one draft prefill per
+        engine bucket) before traffic, then zero both caches' lengths
+        — the same throwaway-token discipline as engine.warmup."""
+        eng = self.engine
+        for b in eng.buckets:
+            ids = jnp.zeros((1, b), jnp.int32)
+            _, cache = eng._timed(
+                "prefill_ms", ("draft_prefill", b),
+                lambda: self._draft_prefill_jit(
+                    self.draft_params, self.draft_cache, ids,
+                    np.int32(0), np.int32(1)))
+            self.draft_cache = cache
+        active = np.zeros(eng.batch_slots, np.int32)
+        self.tick(active)
+        self.draft_cache = StaticKVCache(
+            self.draft_cache.k, self.draft_cache.v,
+            jnp.zeros((eng.batch_slots,), jnp.int32),
+            self.draft_cache.k_scale, self.draft_cache.v_scale)
+        if eng.kv_layout != "paged":
+            eng.cache = StaticKVCache(
+                eng.cache.k, eng.cache.v,
+                jnp.zeros((eng.batch_slots,), jnp.int32),
+                eng.cache.k_scale, eng.cache.v_scale)

@@ -447,6 +447,85 @@ class GPTAttention(Layer):
         out = out[:, None].astype(q.dtype)               # [b, 1, H, D]
         return self._proj_out(out, b, 1), k_layer, v_layer
 
+    def forward_verify(self, x, k_layer, v_layer, lengths,
+                       k_scale=None, v_scale=None):
+        """Windowed multi-token step over one StaticKVCache layer — the
+        spec-decode verify/catch-up primitive: write the W new tokens'
+        k/v at positions ``lengths[b]..lengths[b]+W-1`` (scatter), then
+        run the fused window attention where query i sees
+        ``j <= lengths[b]+i``.  x is [B, W, hidden]; lengths [B] int32
+        EXCLUDING the window.  Returns ``(out, k_layer, v_layer)`` (+
+        scale planes when quantized).  W=1 is numerically the
+        forward_decode step."""
+        b, w = x.shape[0], x.shape[1]
+        cap = k_layer.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        lens = lengths.astype(jnp.int32)
+        idx = jnp.minimum(
+            lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :],
+            cap - 1)                                     # [B, W]
+        rows = jnp.arange(b)[:, None]
+        from .. import ops as _ops
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_layer.dtype)
+            kq, ks = quantize_kv(k, mode)           # [b,w,Hkv,D],[b,w,Hkv]
+            vq, vs = quantize_kv(v, mode)
+            k_layer = k_layer.at[rows, idx].set(kq)
+            v_layer = v_layer.at[rows, idx].set(vq)
+            k_scale = k_scale.at[rows, idx].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[rows, idx].set(vs.astype(v_scale.dtype))
+            out = _ops.decode_attention_window(q, k_layer, v_layer, lens,
+                                               k_scale, v_scale)
+            out = out.astype(q.dtype)               # [b, w, H, D]
+            return (self._proj_out(out, b, w), k_layer, v_layer,
+                    k_scale, v_scale)
+        k_layer = k_layer.at[rows, idx].set(k.astype(k_layer.dtype))
+        v_layer = v_layer.at[rows, idx].set(v.astype(v_layer.dtype))
+        out = _ops.decode_attention_window(
+            q.astype(k_layer.dtype), k_layer, v_layer, lens)
+        out = out.astype(q.dtype)                    # [b, w, H, D]
+        return self._proj_out(out, b, w), k_layer, v_layer
+
+    def forward_verify_paged(self, x, k_pool, v_pool, tables, lengths,
+                             k_scale=None, v_scale=None):
+        """Paged twin of forward_verify: scatter the W new tokens' k/v
+        through each slot's block table at positions
+        ``lengths[b]+i``, then run the paged window attention.  x
+        [B, W, hidden]; tables [B, MB] int32; lengths [B] int32
+        EXCLUDING the window."""
+        b, w = x.shape[0], x.shape[1]
+        bs = k_pool.shape[1]
+        mb = tables.shape[1]
+        q, k, v = self._qkv_arrays(x)
+        lens = lengths.astype(jnp.int32)
+        pos = lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+        blk_pos = jnp.minimum(pos // bs, mb - 1)         # [B, W]
+        off = pos % bs
+        rows = jnp.arange(b)[:, None]
+        blk = tables[rows, blk_pos]                      # [B, W]
+        from .. import ops as _ops
+        if k_scale is not None:
+            from ..ops.quantized_matmul import kv_quant_mode, quantize_kv
+            mode = kv_quant_mode(k_pool.dtype)
+            kq, ks = quantize_kv(k, mode)
+            vq, vs = quantize_kv(v, mode)
+            k_pool = k_pool.at[blk, off].set(kq)
+            v_pool = v_pool.at[blk, off].set(vq)
+            k_scale = k_scale.at[blk, off].set(ks.astype(k_scale.dtype))
+            v_scale = v_scale.at[blk, off].set(vs.astype(v_scale.dtype))
+            out = _ops.paged_decode_attention_window(
+                q, k_pool, v_pool, tables, lens, k_scale, v_scale)
+            out = out.astype(q.dtype)
+            return (self._proj_out(out, b, w), k_pool, v_pool,
+                    k_scale, v_scale)
+        k_pool = k_pool.at[blk, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, off].set(v.astype(v_pool.dtype))
+        out = _ops.paged_decode_attention_window(
+            q.astype(k_pool.dtype), k_pool, v_pool, tables, lens)
+        out = out.astype(q.dtype)
+        return self._proj_out(out, b, w), k_pool, v_pool
+
     def forward_prefill_paged(self, x, k_buf, v_buf, prefix_len):
         """Prefill attention over ONE slot's gathered block buffer:
         ``k_buf``/``v_buf`` are the slot's blocks laid out contiguously
@@ -680,6 +759,42 @@ class GPTBlock(Layer):
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, k_layer, v_layer
+
+    def forward_verify(self, x, k_layer, v_layer, lengths,
+                       k_scale=None, v_scale=None):
+        """Windowed multi-token block step over one StaticKVCache layer
+        (LN/MLP are position-wise, so only attention needs the window
+        machinery)."""
+        if k_scale is not None:
+            a, k_layer, v_layer, k_scale, v_scale = \
+                self.attn.forward_verify(self.ln_1(x), k_layer, v_layer,
+                                         lengths, k_scale, v_scale)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, k_layer, v_layer, k_scale, v_scale
+        a, k_layer, v_layer = self.attn.forward_verify(
+            self.ln_1(x), k_layer, v_layer, lengths)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_layer, v_layer
+
+    def forward_verify_paged(self, x, k_pool, v_pool, tables, lengths,
+                             k_scale=None, v_scale=None):
+        """Windowed multi-token block step over one PagedKVCache
+        layer."""
+        if k_scale is not None:
+            a, k_pool, v_pool, k_scale, v_scale = \
+                self.attn.forward_verify_paged(
+                    self.ln_1(x), k_pool, v_pool, tables, lengths,
+                    k_scale, v_scale)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, k_pool, v_pool, k_scale, v_scale
+        a, k_pool, v_pool = self.attn.forward_verify_paged(
+            self.ln_1(x), k_pool, v_pool, tables, lengths)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_pool, v_pool
 
     # ---- fused (megakernel) decode step --------------------------------
     def _megakernel_weights(self):
@@ -1116,6 +1231,78 @@ class GPTModel(Layer):
         return self.ln_f(x), StaticKVCache(cache_k, cache_v, lengths,
                                            k_sc, v_sc)
 
+    def forward_verify(self, tokens, cache: StaticKVCache):
+        """Windowed multi-token step for every slot — the spec-decode
+        verify (and draft catch-up) primitive: process ``tokens
+        [B, W]`` as W consecutive new tokens per slot starting at each
+        slot's current length, writing their k/v into the cache and
+        attending each window query i against positions
+        ``j <= lengths[b]+i``.  Returns ``(hidden [B, W, H], cache)``
+        with lengths UNCHANGED — the caller (the spec tick) advances
+        them by the count it actually commits, which it only knows
+        after the acceptance rule runs on these logits.  Positions
+        beyond the committed count hold garbage above the advanced
+        length, exactly the masked-garbage convention of
+        forward_decode."""
+        cfg = self.cfg
+        toks = tokens.data if isinstance(tokens, Tensor) \
+            else jnp.asarray(tokens)
+        b, w = toks.shape
+        lens = cache.lengths.astype(jnp.int32)
+        pos = jnp.minimum(
+            lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :],
+            cfg.max_seq_len - 1)
+        x = self.wte(Tensor(toks)) + self.wpe(Tensor(pos))
+        x = self.drop(x)
+        cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
+        for i, blk in enumerate(self.blocks):
+            if k_sc is not None:
+                x, k_layer, v_layer, ks_l, vs_l = blk.forward_verify(
+                    x, cache_k[i], cache_v[i], lens, k_sc[i], v_sc[i])
+                k_sc = k_sc.at[i].set(ks_l)
+                v_sc = v_sc.at[i].set(vs_l)
+            else:
+                x, k_layer, v_layer = blk.forward_verify(
+                    x, cache_k[i], cache_v[i], lens)
+            cache_k = cache_k.at[i].set(k_layer)
+            cache_v = cache_v.at[i].set(v_layer)
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v,
+                                           cache.lengths, k_sc, v_sc)
+
+    def forward_verify_paged(self, tokens, cache, tables, lengths):
+        """Paged twin of forward_verify: W consecutive tokens per slot
+        scattered through the block tables.  Lengths are HOST state
+        (the scheduler owns block accounting) and ride in as an
+        operand, EXCLUDING the window.  Returns
+        ``(hidden [B, W, H], cache)``."""
+        cfg = self.cfg
+        tables = jnp.asarray(tables, jnp.int32)
+        toks = tokens.data if isinstance(tokens, Tensor) \
+            else jnp.asarray(tokens)
+        b, w = toks.shape
+        lens = jnp.asarray(lengths, jnp.int32)
+        pos = jnp.minimum(
+            lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :],
+            cfg.max_seq_len - 1)
+        x = self.wte(Tensor(toks)) + self.wpe(Tensor(pos))
+        x = self.drop(x)
+        cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
+        for i, blk in enumerate(self.blocks):
+            if k_sc is not None:
+                x, k_pool, v_pool, ks_p, vs_p = blk.forward_verify_paged(
+                    x, cache_k[i], cache_v[i], tables, lens,
+                    k_sc[i], v_sc[i])
+                k_sc = k_sc.at[i].set(ks_p)
+                v_sc = v_sc.at[i].set(vs_p)
+            else:
+                x, k_pool, v_pool = blk.forward_verify_paged(
+                    x, cache_k[i], cache_v[i], tables, lens)
+            cache_k = cache_k.at[i].set(k_pool)
+            cache_v = cache_v.at[i].set(v_pool)
+        return self.ln_f(x), type(cache)(cache_k, cache_v, k_sc, v_sc)
+
     # ---- serving path: paged KV cache ---------------------------------
     def forward_prefill_paged(self, input_ids, cache, table_row,
                               prefix_len):
@@ -1348,6 +1535,24 @@ class GPTForCausalLM(Layer):
         h, cache = self.gpt.forward_decode(tokens, cache, active)
         logits = self._head_logits(h)                     # [B, 1, V]
         return logits.data[:, 0], cache
+
+    def verify_step(self, tokens, cache: StaticKVCache):
+        """Windowed multi-token step for all slots (spec-decode verify /
+        draft catch-up); returns ``(logits [B, W, V], cache)`` — the
+        logits at every window position, i.e. logits[:, i] is the
+        next-token distribution after consuming tokens[:, :i+1].
+        Lengths are NOT advanced (see GPTModel.forward_verify)."""
+        h, cache = self.gpt.forward_verify(tokens, cache)
+        logits = self._head_logits(h)                     # [B, W, V]
+        return logits.data, cache
+
+    def verify_step_paged(self, tokens, cache, tables, lengths):
+        """Paged windowed multi-token step for all slots; returns
+        ``(logits [B, W, V], cache)``."""
+        h, cache = self.gpt.forward_verify_paged(tokens, cache, tables,
+                                                 lengths)
+        logits = self._head_logits(h)
+        return logits.data, cache
 
     def prefill_paged(self, input_ids, cache, table_row, prefix_len,
                       suffix_len):

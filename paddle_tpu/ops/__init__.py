@@ -11,7 +11,8 @@ from .flash_attention import (  # noqa: F401
     set_interpret_mode)
 from .decode_attention import (  # noqa: F401
     decode_attention, decode_attention_available,
-    paged_decode_attention, paged_decode_attention_available)
+    decode_attention_window, paged_decode_attention,
+    paged_decode_attention_available, paged_decode_attention_window)
 from .fused_cross_entropy import (  # noqa: F401
     fused_linear_cross_entropy, pick_vocab_block)
 from .quantized_matmul import (  # noqa: F401

@@ -49,6 +49,7 @@ _fa = importlib.import_module(__package__ + ".flash_attention")
 
 __all__ = ["decode_attention", "decode_attention_available",
            "paged_decode_attention", "paged_decode_attention_available",
+           "decode_attention_window", "paged_decode_attention_window",
            "set_interpret_mode"]
 
 _NEG = -1e30
@@ -525,3 +526,390 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
     else:
         o3 = _paged_gqa(q3, k_pool, v_pool, tables, lengths)
     return o3.reshape(b, hkv, h // hkv, d).reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
+# window variant: K+1 query tokens per slot in ONE call — the verify
+# half of speculative decoding (Leviathan et al.).  The draft proposes K
+# tokens; the target model scores all K+1 positions against the cache in
+# one fixed-shape executable instead of K+1 sequential decode steps.
+# Query i (absolute position lengths[b]+i) attends cache positions
+# j <= lengths[b]+i, where `lengths` counts tokens cached BEFORE the
+# window (the caller scatters the window's k/v at lengths..lengths+W-1
+# first, exactly like the single-token write-then-attend order).
+# ---------------------------------------------------------------------------
+def _window_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, block_k: int,
+                   g: int, scale: float):
+    """One (b·hkv) program: q_ref [W·G, D] — W window queries × G query
+    heads per kv head, rows grouped w·G+g; k/v [S, D] cache strips;
+    m_ref (W, S) f32 per-QUERY validity (the staircase mask); o [W·G, D].
+    Same online softmax as _decode_kernel with the mask row picked per
+    query row."""
+    wg, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+
+    q = q_ref[:]
+    m0 = jnp.full((wg, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((wg, 1), jnp.float32)
+    acc0 = jnp.zeros((wg, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        sblk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [wg, bk] f32
+        kv_f = m_ref[:, pl.ds(j * block_k, block_k)]       # (W, bk) f32
+        kv_f = jnp.repeat(kv_f, g, axis=0)                 # (wg, bk)
+        sblk = jnp.where(kv_f > 0, sblk, _NEG)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        p = jnp.where(sblk <= _NEG / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _window_kernel_q(q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref, o_ref,
+                     *, block_k: int, g: int, scale: float):
+    """Quantized-cache window kernel: int8 strips + (1, S) f32 scale
+    strips dequantized after the DMA (scales are per cache POSITION, so
+    they are shared by every query row)."""
+    wg, d = q_ref.shape
+    s = k_ref.shape[0]
+    n_k = s // block_k
+
+    q = q_ref[:]
+    m0 = jnp.full((wg, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((wg, 1), jnp.float32)
+    acc0 = jnp.zeros((wg, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        ks = ks_ref[0, pl.ds(j * block_k, block_k)]
+        vs = vs_ref[0, pl.ds(j * block_k, block_k)]
+        k_blk = (k_ref[pl.ds(j * block_k, block_k), :]
+                 .astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+        v_blk = (v_ref[pl.ds(j * block_k, block_k), :]
+                 .astype(jnp.float32) * vs[:, None]).astype(q.dtype)
+        sblk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kv_f = jnp.repeat(m_ref[:, pl.ds(j * block_k, block_k)], g,
+                          axis=0)
+        sblk = jnp.where(kv_f > 0, sblk, _NEG)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        p = jnp.where(sblk <= _NEG / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _window_gqa(q3, k3, v3, mask, ks3=None, vs3=None, block_k=512):
+    """q3 [B·Hkv, W·G, D]; k3/v3 [B·Hkv, S, D]; mask [B, W, S] f32;
+    quantized path adds ks3/vs3 [B·Hkv, 1, S] f32 scale strips."""
+    bhkv, wg, d = q3.shape
+    s = k3.shape[1]
+    b, w = mask.shape[0], mask.shape[1]
+    hkv = bhkv // b
+    g = wg // w
+    block_k = _fa._pick_block(s, block_k)
+    scale = 1.0 / math.sqrt(d)
+    mask_spec = pl.BlockSpec((None, w, s),
+                             lambda i, hkv=hkv: (i // hkv, 0, 0))
+    io_spec = pl.BlockSpec((None, wg, d), lambda i: (i, 0, 0))
+    kv_spec = pl.BlockSpec((None, s, d), lambda i: (i, 0, 0))
+    if ks3 is None:
+        kernel = functools.partial(_window_kernel, block_k=block_k, g=g,
+                                   scale=scale)
+        in_specs = [io_spec, kv_spec, kv_spec, mask_spec]
+        args = (q3, k3, v3, mask)
+    else:
+        kernel = functools.partial(_window_kernel_q, block_k=block_k,
+                                   g=g, scale=scale)
+        sc_spec = pl.BlockSpec((None, 1, s), lambda i: (i, 0, 0))
+        in_specs = [io_spec, kv_spec, kv_spec, sc_spec, sc_spec,
+                    mask_spec]
+        args = (q3, k3, v3, ks3, vs3, mask)
+    return pl.pallas_call(
+        kernel,
+        grid=(bhkv,),
+        in_specs=in_specs,
+        out_specs=io_spec,
+        out_shape=jax.ShapeDtypeStruct((bhkv, wg, d), q3.dtype),
+        interpret=_interpret(),
+    )(*args)
+
+
+def _window_composite(q, k_cache, v_cache, lengths, k_scale=None,
+                      v_scale=None):
+    """XLA reference math for the window variant. q [B, W, H, D];
+    caches [B, S, Hkv, D]; lengths [B] int32 EXCLUDING the window
+    (query i sees cache positions j <= lengths[b]+i)."""
+    if k_scale is not None:
+        k_cache = _dequant_cache(k_cache, k_scale, q.dtype)
+        v_cache = _dequant_cache(v_cache, v_scale, q.dtype)
+    b, w, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, w, hkv, g, d)
+    kh = jnp.swapaxes(k_cache, 1, 2)                 # [b, hkv, s, d]
+    vh = jnp.swapaxes(v_cache, 1, 2)
+    scores = jnp.einsum("bwkgd,bksd->bkwgs", qg, kh,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    limit = lengths.astype(jnp.int32)[:, None] + \
+        jnp.arange(w, dtype=jnp.int32)[None, :] + 1        # [b, w]
+    valid = jnp.arange(s)[None, None, :] < limit[:, :, None]
+    scores = jnp.where(valid[:, None, :, None, :], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkwgs,bksd->bwkgd", probs, vh)
+    return out.reshape(b, w, h, d).astype(q.dtype)
+
+
+def decode_attention_window(q, k_cache, v_cache, lengths, k_scale=None,
+                            v_scale=None):
+    """Windowed multi-token attention over a static KV cache — the
+    spec-decode verify primitive.
+
+    q ``[B, W, H, D]`` — W consecutive new tokens' queries per slot
+    (W = draft K + 1 in the verify step); k_cache/v_cache
+    ``[B, S, Hkv, D]`` AFTER the window's k/v were written at positions
+    ``lengths..lengths+W-1``; lengths ``[B]`` int32 — tokens cached
+    BEFORE the window.  Query i attends ``j <= lengths[b]+i`` (itself
+    included), so logits[i] is exactly what a sequential decode of
+    token i would produce — that equivalence is the token-identity
+    guarantee speculative decoding rests on.  ``W=1`` reduces to
+    ``decode_attention`` with lengths+1.  Quantized caches pass their
+    ``[B, S, Hkv]`` f32 scale planes.  Returns ``[B, W, H, D]``."""
+    b, w, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    quantized = k_scale is not None
+    supported = (s % 128 == 0 and (d % 128 == 0 or d == 64)
+                 and h % hkv == 0
+                 and (not quantized or k_cache.dtype == jnp.int8))
+    if not supported or not decode_attention_available():
+        return _window_composite(q, k_cache, v_cache, lengths,
+                                 k_scale, v_scale)
+    limit = lengths.astype(jnp.int32)[:, None] + \
+        jnp.arange(w, dtype=jnp.int32)[None, :] + 1
+    mask = (jnp.arange(s)[None, None, :] <
+            limit[:, :, None]).astype(jnp.float32)          # [b, w, s]
+    # rows grouped (w, g): [b, w, hkv, g, d] -> [b, hkv, w, g, d]
+    q3 = q.reshape(b, w, hkv, h // hkv, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b * hkv, w * (h // hkv), d)
+    k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
+    v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
+    ks3 = vs3 = None
+    if quantized:
+        ks3 = jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2) \
+            .reshape(b * hkv, 1, s)
+        vs3 = jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2) \
+            .reshape(b * hkv, 1, s)
+    o3 = _window_gqa(q3, k3, v3, mask, ks3, vs3)
+    return o3.reshape(b, hkv, w, h // hkv, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, w, h, d)
+
+
+def _paged_window_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, block_size: int,
+                         hkv: int, g: int, scale: float):
+    """Paged window program (b·hkv, j): like _paged_kernel with W·G
+    query rows and the staircase mask computed in-kernel — row r's
+    window index is r//g, so position p is valid iff
+    p < len_ref[b] + r//g + 1."""
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    b = pl.program_id(0) // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:]                                        # [W·G, D]
+    wg = q.shape[0]
+    k_blk = k_ref[:]
+    v_blk = v_ref[:]
+    sblk = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # [wg, bs] f32
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)                  # [1, bs]
+    win = jax.lax.broadcasted_iota(jnp.int32, (wg, 1), 0) // g
+    sblk = jnp.where(pos < len_ref[b] + win + 1, sblk, _NEG)
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+    p = jnp.exp(sblk - m_new)
+    p = jnp.where(sblk <= _NEG / 2, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_window_kernel_q(tbl_ref, len_ref, q_ref, k_ref, v_ref,
+                           ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
+                           *, block_size: int, hkv: int, g: int,
+                           scale: float):
+    """Quantized paged window program: dequantize the int8 strip with
+    its [1, bs] scale strip after the DMA, then _paged_window_kernel's
+    math."""
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    b = pl.program_id(0) // hkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:]
+    wg = q.shape[0]
+    ks = ks_ref[0, :]
+    vs = vs_ref[0, :]
+    k_blk = (k_ref[:].astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+    v_blk = (v_ref[:].astype(jnp.float32) * vs[:, None]).astype(q.dtype)
+    sblk = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    win = jax.lax.broadcasted_iota(jnp.int32, (wg, 1), 0) // g
+    sblk = jnp.where(pos < len_ref[b] + win + 1, sblk, _NEG)
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+    p = jnp.exp(sblk - m_new)
+    p = jnp.where(sblk <= _NEG / 2, 0.0, p)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_window_gqa(q3, k_pool, v_pool, tables, lengths, w,
+                      k_scale=None, v_scale=None):
+    """q3 [B·Hkv, W·G, D]; pools/tables/lengths as _paged_gqa; scale
+    pools transposed to [NB, Hkv, bs] strips when quantized."""
+    pltpu = _fa.pltpu
+    bhkv, wg, d = q3.shape
+    bs = k_pool.shape[1]
+    b, mb = tables.shape
+    hkv = bhkv // b
+    g = wg // w
+    scale = 1.0 / math.sqrt(d)
+    kv_spec = pl.BlockSpec(
+        (None, bs, None, d),
+        lambda i, j, tbl, lens, hkv=hkv: (tbl[i // hkv, j], 0, i % hkv, 0))
+    io_spec = pl.BlockSpec((None, wg, d),
+                           lambda i, j, tbl, lens: (i, 0, 0))
+    scratch = [
+        pltpu.VMEM((wg, 128), jnp.float32),
+        pltpu.VMEM((wg, 128), jnp.float32),
+        pltpu.VMEM((wg, d), jnp.float32),
+    ]
+    if k_scale is None:
+        in_specs = [io_spec, kv_spec, kv_spec]
+        kernel = functools.partial(_paged_window_kernel, block_size=bs,
+                                   hkv=hkv, g=g, scale=scale)
+        args = (q3, k_pool, v_pool)
+    else:
+        sc_spec = pl.BlockSpec(
+            (None, 1, bs),
+            lambda i, j, tbl, lens, hkv=hkv: (tbl[i // hkv, j],
+                                              i % hkv, 0))
+        in_specs = [io_spec, kv_spec, kv_spec, sc_spec, sc_spec]
+        kernel = functools.partial(_paged_window_kernel_q, block_size=bs,
+                                   hkv=hkv, g=g, scale=scale)
+        args = (q3, k_pool, v_pool,
+                jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2),
+                jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bhkv, mb),
+        in_specs=in_specs,
+        out_specs=io_spec,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bhkv, wg, d), q3.dtype),
+        interpret=_interpret(),
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), *args)
+
+
+def _paged_window_composite(q, k_pool, v_pool, tables, lengths,
+                            k_scale=None, v_scale=None):
+    """Gather the slot's blocks dense, reuse the dense window composite
+    — bitwise the dense path on identical cache contents."""
+    b, mb = tables.shape
+    bs, hkv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
+    kg = k_pool[tables].reshape(b, mb * bs, hkv, d)
+    vg = v_pool[tables].reshape(b, mb * bs, hkv, d)
+    ksg = vsg = None
+    if k_scale is not None:
+        ksg = k_scale[tables].reshape(b, mb * bs, hkv)
+        vsg = v_scale[tables].reshape(b, mb * bs, hkv)
+    return _window_composite(q, kg, vg, lengths, ksg, vsg)
+
+
+def paged_decode_attention_window(q, k_pool, v_pool, tables, lengths,
+                                  k_scale=None, v_scale=None):
+    """Windowed multi-token attention over a PAGED KV cache — the
+    spec-decode verify primitive for the paged layout.  q
+    ``[B, W, H, D]``; pools/tables as :func:`paged_decode_attention`;
+    lengths ``[B]`` int32 EXCLUDING the window (its k/v were already
+    scattered through the block table at positions
+    ``lengths..lengths+W-1``).  Query i attends ``j <= lengths[b]+i``.
+    Pallas scalar-prefetch kernel when shapes allow, gather composite
+    (ground truth) otherwise."""
+    b, w, h, d = q.shape
+    bs, hkv = k_pool.shape[1], k_pool.shape[2]
+    quantized = k_scale is not None
+    supported = (bs % 128 == 0 and (d % 128 == 0 or d == 64)
+                 and h % hkv == 0
+                 and (not quantized or k_pool.dtype == jnp.int8))
+    if not supported or not paged_decode_attention_available():
+        return _paged_window_composite(q, k_pool, v_pool, tables,
+                                       lengths, k_scale, v_scale)
+    q3 = q.reshape(b, w, hkv, h // hkv, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b * hkv, w * (h // hkv), d)
+    o3 = _paged_window_gqa(q3, k_pool, v_pool, tables, lengths, w,
+                           k_scale, v_scale)
+    return o3.reshape(b, hkv, w, h // hkv, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, w, h, d)

@@ -125,31 +125,49 @@ def megakernel_enabled(cfg) -> bool:
     return bool(getattr(cfg, "decode_megakernel", False))
 
 
-def _pick_blocks(seq_extent: int, ffn: int):
-    """(block_s, block_f) for the KV stream / MLP tiles; env
-    PADDLE_TPU_MEGAKERNEL_BLOCKS="s,f" overrides, clamped to divide."""
+def _pick_blocks(seq_extent: int, ffn: int, qkv_cols: int = 0,
+                 h: int = 0):
+    """(block_s, block_f, block_q, block_o) for the KV stream / MLP
+    tiles / qkv-projection column tiles / out-projection row tiles; env
+    PADDLE_TPU_MEGAKERNEL_BLOCKS="s,f[,q,o]" overrides, clamped to
+    divide.  Tiling the qkv/out weight fetches (instead of keeping both
+    matrices resident) is what lets gpt3-350m-class layers fit the VMEM
+    gate — a tile is fetched once per phase with slots innermost, so
+    the HBM traffic is unchanged."""
     env = os.environ.get("PADDLE_TPU_MEGAKERNEL_BLOCKS", "").strip()
-    want_s, want_f = 512, 256
+    want_s, want_f, want_q, want_o = 512, 256, 512, 512
     if env:
         try:
-            want_s, want_f = (int(x) for x in env.split(","))
+            parts = [int(x) for x in env.split(",")]
+            if len(parts) >= 2:
+                want_s, want_f = parts[0], parts[1]
+            if len(parts) >= 4:
+                want_q, want_o = parts[2], parts[3]
         except ValueError:
             pass
-    return _fa._pick_block(seq_extent, want_s), _fa._pick_block(ffn, want_f)
+    return (_fa._pick_block(seq_extent, want_s),
+            _fa._pick_block(ffn, want_f),
+            _fa._pick_block(qkv_cols, want_q) if qkv_cols else 0,
+            _fa._pick_block(h, want_o) if h else 0)
 
 
-def _vmem_estimate(h, kvd, f, block_s, block_f, hkv, d, w_item, kv_item,
-                   quantized, batch):
+def _vmem_estimate(h, kvd, f, block_s, block_f, block_q, block_o, hkv,
+                   d, w_item, kv_item, quantized, batch):
     """Rough resident-VMEM bytes: streamed operands counted at 2x
-    (double buffering), resident weights at 1x (their block index never
-    changes so Mosaic keeps one copy), plus the per-slot scratch."""
-    resident = (h * (h + 2 * kvd) + h * h + 2 * h) * w_item  # qkv+out+vecs
-    streamed = 2 * (h * block_f + block_f * h) * w_item      # up/down tiles
+    (double buffering) — which, after the qkv/out tiling, is EVERY
+    weight matrix; only the LayerNorm/bias vectors stay resident —
+    plus the per-slot scratch."""
+    resident = 8 * h * w_item                    # ln1/ln2 w+b, bout, bdown
+    streamed = 2 * (h * block_q + block_q) * w_item          # qkv tile
+    streamed += 2 * block_o * h * w_item                     # out tile
+    streamed += 2 * (h * block_f + block_f + block_f * h) * w_item  # mlp
     streamed += 2 * 2 * block_s * hkv * d * kv_item          # k+v strips
     if quantized:
         streamed += 2 * 2 * block_s * hkv * 4                # scale strips
-    scratch = batch * (3 * h + 2 * hkv * d + d * hkv * (h // (hkv * d))
-                       ) * 4 + batch * 2 * (h // d) * 128 * 4
+    qkv_cols = h + 2 * kvd
+    heads = h // d
+    scratch = batch * (qkv_cols + 5 * h + heads * d) * 4 \
+        + batch * 2 * heads * 128 * 4
     return resident + streamed + scratch
 
 
@@ -168,9 +186,10 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
                  wout_ref, bout_ref, ln2w_ref, ln2b_ref, wup_ref, bup_ref,
                  wdown_ref, bdown_ref, k_ref, v_ref, ks_ref, vs_ref,
                  xo_ref, kn_ref, vn_ref,
-                 q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr,
-                 x2_scr, h2_scr, mlp_scr,
-                 *, ns: int, nf: int, block_s: int, heads: int, hkv: int,
+                 qkv_scr, m_scr, l_scr, acc_scr,
+                 attn_scr, o_scr, x2_scr, h2_scr, mlp_scr,
+                 *, nq: int, ns: int, no: int, nf: int, block_s: int,
+                 block_q: int, block_o: int, heads: int, hkv: int,
                  d: int, h: int, scale: float, eps: float, cap: int,
                  quantized: bool, paged: bool):
     """One (phase, slot) program.  Scalar-prefetched ``len_ref`` carries
@@ -178,10 +197,26 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
     the paged layout the block table already acted inside the index
     maps, so the body only sees [block_s, Hkv, D] strips either way.
     ``ks_ref``/``vs_ref`` are the f32 scale strips of an int8 cache
-    (aliases of k_ref/v_ref in the fp path, unread)."""
+    (aliases of k_ref/v_ref in the fp path, unread).
+
+    Phase layout (nq qkv column tiles, ns KV blocks, 1 softmax
+    finalize, no out-proj row tiles, nf MLP tiles — every weight
+    matrix STREAMS tile by tile, the widened-VMEM-gate satellite):
+
+        [0, nq)                qkv tile t = p: ln1(x) recomputed (one
+                               [1,H] VPU pass per tile — noise), one
+                               [H, block_q] weight tile, result into
+                               the qkv scratch column slice
+        [nq, nq+ns)            KV block j = p-nq, online softmax
+        nq+ns                  fold new token, finalize -> attn scratch
+        (nq+ns, nq+ns+no]      out-proj row tile t accumulates into the
+                               o scratch; the LAST tile adds residual +
+                               bias and runs ln2
+        (nq+ns+no, +nf]        MLP tiles; the last one also writes"""
     p = pl.program_id(0)
     b = pl.program_id(1)
     g = heads // hkv
+    kvd = hkv * d
     bsl = pl.ds(b, 1)
 
     # the slot's logical write position for the new token: the composed
@@ -189,31 +224,31 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
     length = len_ref[b]
     idx = jnp.minimum(length, cap - 1) if not paged else length
 
-    @pl.when(p == 0)
-    def _qkv():
+    @pl.when(p < nq)
+    def _qkv_tile():
         xb = x_ref[...].astype(jnp.float32)               # [1, H]
         mu = jnp.mean(xb, axis=-1, keepdims=True)
         var = jnp.mean((xb - mu) ** 2, axis=-1, keepdims=True)
         h1 = (xb - mu) * jax.lax.rsqrt(var + eps)
         h1 = h1 * ln1w_ref[...].astype(jnp.float32) + \
             ln1b_ref[...].astype(jnp.float32)
-        qkv = jax.lax.dot_general(
+        tile = jax.lax.dot_general(
             h1.astype(wqkv_ref.dtype), wqkv_ref[...],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) + \
-            bqkv_ref[...].astype(jnp.float32)             # [1, H+2KVD]
-        kvd = hkv * d
-        q_scr[bsl] = qkv[:, :h].reshape(1, heads, d)
-        kn_scr[bsl] = qkv[:, h:h + kvd].reshape(1, hkv, d)
-        vn_scr[bsl] = qkv[:, h + kvd:].reshape(1, hkv, d)
+            bqkv_ref[...].astype(jnp.float32)             # [1, block_q]
+        qkv_scr[bsl, pl.ds(p * block_q, block_q)] = tile
+
+    @pl.when(p == nq - 1)
+    def _attend_init():
         m_scr[bsl] = jnp.full((1,) + m_scr.shape[1:], _NEG, jnp.float32)
         l_scr[bsl] = jnp.zeros((1,) + l_scr.shape[1:], jnp.float32)
         acc_scr[bsl] = jnp.zeros((1, heads, d), jnp.float32)
 
-    @pl.when(p < ns)
+    @pl.when((p >= nq) & (p < nq + ns))
     def _attend():
-        q = q_scr[bsl][0]                                 # [heads, d] f32
-        pos = p * block_s + jax.lax.broadcasted_iota(
+        q = qkv_scr[bsl, :h].reshape(heads, d)            # [heads, d] f32
+        pos = (p - nq) * block_s + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_s), 1)
         valid = pos < idx                                 # [1, block_s]
         scores, vals = [], []
@@ -249,11 +284,11 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
                                       (1,) + l_scr.shape[1:])
         acc_scr[bsl] = acc_new[None]
 
-    @pl.when(p == ns)
+    @pl.when(p == nq + ns)
     def _finalize():
-        q = q_scr[bsl][0]                                 # [heads, d]
-        kn = kn_scr[bsl][0]                               # [hkv, d] f32
-        vn = vn_scr[bsl][0]
+        q = qkv_scr[bsl, :h].reshape(heads, d)            # [heads, d]
+        kn = qkv_scr[bsl, h:h + kvd].reshape(hkv, d)      # [hkv, d] f32
+        vn = qkv_scr[bsl, h + kvd:].reshape(hkv, d)
         if quantized:
             # the composed path STORES the new k/v quantized and attends
             # the dequantized codes; reproduce that round trip exactly
@@ -277,11 +312,25 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
         l_new = l_prev * alpha + pnew
         acc = acc_prev * alpha + pnew * vn_rep
         attn = acc / jnp.maximum(l_new, 1e-30)            # [heads, d]
-        o = jax.lax.dot_general(
-            attn.reshape(1, h).astype(wout_ref.dtype), wout_ref[...],
+        attn_scr[bsl] = attn.reshape(1, 1, h)
+        o_scr[bsl] = jnp.zeros((1, 1, h), jnp.float32)
+
+    @pl.when((p > nq + ns) & (p <= nq + ns + no))
+    def _out_tile():
+        t = p - nq - ns - 1
+        attn_t = attn_scr[bsl, :, pl.ds(t * block_o, block_o)] \
+            .reshape(1, block_o)
+        part = jax.lax.dot_general(
+            attn_t.astype(wout_ref.dtype), wout_ref[...],
             (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) + \
-            bout_ref[...].astype(jnp.float32)             # [1, H]
+            preferred_element_type=jnp.float32)           # [1, H]
+        o_scr[bsl] = o_scr[bsl] + part[None]
+
+    @pl.when(p == nq + ns + no)
+    def _residual_ln2():
+        # the LAST out-proj tile just accumulated above (source order);
+        # close the attention half: bias + residual + ln2
+        o = o_scr[bsl][0] + bout_ref[...].astype(jnp.float32)
         x2 = x_ref[...].astype(jnp.float32) + o
         mu = jnp.mean(x2, axis=-1, keepdims=True)
         var = jnp.mean((x2 - mu) ** 2, axis=-1, keepdims=True)
@@ -292,7 +341,7 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
         h2_scr[bsl] = h2[None]
         mlp_scr[bsl] = jnp.zeros((1, 1, h), jnp.float32)
 
-    @pl.when(p > ns)
+    @pl.when(p > nq + ns + no)
     def _mlp():
         h2 = h2_scr[bsl][0]                               # [1, H] f32
         u = jax.lax.dot_general(
@@ -307,23 +356,28 @@ def _mega_kernel(len_ref, x_ref, ln1w_ref, ln1b_ref, wqkv_ref, bqkv_ref,
             preferred_element_type=jnp.float32)           # [1, H]
         mlp_scr[bsl] = mlp_scr[bsl] + part[None]
 
-    @pl.when(p == ns + nf)
+    @pl.when(p == nq + ns + no + nf)
     def _write():
         # the LAST visit of slot b's output blocks: earlier phases flush
-        # whatever the buffers held, but this write lands last and wins
+        # whatever the buffers held, but this write lands last and wins.
+        # k_new/v_new leave RAW (pre-quantization) — the caller owns the
+        # cache write, exactly like the composed path
         xo_ref[...] = (x2_scr[bsl][0] + mlp_scr[bsl][0] +
                        bdown_ref[...].astype(jnp.float32)
                        ).astype(xo_ref.dtype)
-        kn_ref[...] = kn_scr[bsl][0].astype(kn_ref.dtype)
-        vn_ref[...] = vn_scr[bsl][0].astype(vn_ref.dtype)
+        kn_ref[...] = qkv_scr[bsl, h:h + kvd].reshape(
+            1, hkv, d)[0].astype(kn_ref.dtype)
+        vn_ref[...] = qkv_scr[bsl, h + kvd:].reshape(
+            1, hkv, d)[0].astype(vn_ref.dtype)
 
 
 def _run_mega(x, w, k_src, v_src, ks_src, vs_src, lengths, *, ns, cap,
-              eps, quantized, paged, kv_index_map, sc_index_map,
+              eps, quantized, paged, kv_map_factory, sc_map_factory,
               extra_scalars=()):
     """Shared pallas_call wrapper: builds grid/specs around the kernel
-    body.  ``kv_index_map``/``sc_index_map`` close over the layout
-    (dense strip walk vs paged table indirection)."""
+    body.  ``kv_map_factory``/``sc_map_factory`` take the qkv-tile
+    phase count ``nq`` (the KV phases start at ``nq``) and return the
+    layout's index map (dense strip walk vs paged table indirection)."""
     pltpu = _fa.pltpu
     (ln1_w, ln1_b, w_qkv, b_qkv, w_out, b_out,
      ln2_w, ln2_b, w_up, b_up, w_down, b_down) = w
@@ -334,29 +388,44 @@ def _run_mega(x, w, k_src, v_src, ks_src, vs_src, lengths, *, ns, cap,
     # from the cache head_dim
     heads = (w_qkv.shape[1] - 2 * kvd) // d
     f = w_up.shape[1]
+    qkv_cols = h + 2 * kvd
     if paged:
         block_s = k_src.shape[1]          # one pool block per phase
-        block_f = _pick_blocks(block_s, f)[1]
+        _, block_f, block_q, block_o = _pick_blocks(block_s, f,
+                                                    qkv_cols, h)
     else:
-        block_s, block_f = _pick_blocks(k_src.shape[1], f)
+        block_s, block_f, block_q, block_o = _pick_blocks(
+            k_src.shape[1], f, qkv_cols, h)
+    nq = qkv_cols // block_q
+    no = h // block_o
     nf = f // block_f
-    np_total = ns + 1 + nf
+    np_total = nq + ns + 1 + no + nf
     scale = 1.0 / math.sqrt(d)
+    kv_index_map = kv_map_factory(nq)
+    sc_index_map = sc_map_factory(nq)
 
     def vec2(a):
         return a.reshape(1, -1)
 
     n_scal = 1 + len(extra_scalars)
-    # weight specs: constant-index blocks stay resident for the whole
-    # kernel; up/down tiles advance only during the MLP phases
+    # weight specs: every matrix streams tile by tile — qkv columns
+    # during the leading phases, out rows after the softmax finalize,
+    # up/down during the MLP phases; only the LN/bias vectors keep a
+    # constant block index (one fetch, resident)
     def _const(shape):
         return pl.BlockSpec(shape, lambda p, b, *s: (0,) * len(shape))
 
+    def _tile_qkv(p, b, *s):
+        return (0, jnp.clip(p, 0, nq - 1))
+
+    def _tile_out(p, b, *s):
+        return (jnp.clip(p - nq - ns - 1, 0, no - 1), 0)
+
     def _tile_up(p, b, *s):
-        return (0, jnp.clip(p - ns - 1, 0, nf - 1))
+        return (0, jnp.clip(p - nq - ns - no - 1, 0, nf - 1))
 
     def _tile_down(p, b, *s):
-        return (jnp.clip(p - ns - 1, 0, nf - 1), 0)
+        return (jnp.clip(p - nq - ns - no - 1, 0, nf - 1), 0)
 
     if quantized:
         sc_spec = pl.BlockSpec((None, block_s, hkv), sc_index_map)
@@ -367,8 +436,10 @@ def _run_mega(x, w, k_src, v_src, ks_src, vs_src, lengths, *, ns, cap,
     in_specs = [
         pl.BlockSpec((1, h), lambda p, b, *s: (b, 0)),          # x
         _const((1, h)), _const((1, h)),                         # ln1 w/b
-        _const((h, h + 2 * kvd)), _const((1, h + 2 * kvd)),     # qkv
-        _const((h, h)), _const((1, h)),                         # out
+        pl.BlockSpec((h, block_q), _tile_qkv),                  # qkv w
+        pl.BlockSpec((1, block_q), _tile_qkv),                  # qkv b
+        pl.BlockSpec((block_o, h), _tile_out),                  # out w
+        _const((1, h)),                                         # out b
         _const((1, h)), _const((1, h)),                         # ln2 w/b
         pl.BlockSpec((h, block_f), _tile_up),                   # up w
         pl.BlockSpec((1, block_f), _tile_up),                   # up b
@@ -390,19 +461,20 @@ def _run_mega(x, w, k_src, v_src, ks_src, vs_src, lengths, *, ns, cap,
         in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((bsz, heads, d), jnp.float32),    # q
-            pltpu.VMEM((bsz, hkv, d), jnp.float32),      # k_new
-            pltpu.VMEM((bsz, hkv, d), jnp.float32),      # v_new
+            pltpu.VMEM((bsz, qkv_cols), jnp.float32),    # qkv (q|k|v new)
             pltpu.VMEM((bsz, heads, 128), jnp.float32),  # running max
             pltpu.VMEM((bsz, heads, 128), jnp.float32),  # running denom
             pltpu.VMEM((bsz, heads, d), jnp.float32),    # attn accum
+            pltpu.VMEM((bsz, 1, h), jnp.float32),        # attn out
+            pltpu.VMEM((bsz, 1, h), jnp.float32),        # out-proj accum
             pltpu.VMEM((bsz, 1, h), jnp.float32),        # x2 residual
             pltpu.VMEM((bsz, 1, h), jnp.float32),        # ln2 output
             pltpu.VMEM((bsz, 1, h), jnp.float32),        # mlp accum
         ],
     )
     kernel = functools.partial(
-        _mega_kernel, ns=ns, nf=nf, block_s=block_s, heads=heads,
+        _mega_kernel, nq=nq, ns=ns, no=no, nf=nf, block_s=block_s,
+        block_q=block_q, block_o=block_o, heads=heads,
         hkv=hkv, d=d, h=h, scale=scale, eps=eps, quantized=quantized,
         paged=paged, cap=cap)
     n_extra = len(extra_scalars)
@@ -567,10 +639,11 @@ def _fused_supported(x, w, hkv, d, block_s, quantize, kv_dtype,
         return False
     if block_s % 128:
         return False
-    block_f = _pick_blocks(block_s, f)[1]
+    _, block_f, block_q, block_o = _pick_blocks(block_s, f,
+                                                h + 2 * kvd, h)
     w_item = jnp.dtype(w_qkv.dtype).itemsize
-    est = _vmem_estimate(h, kvd, f, block_s, block_f, hkv, d, w_item,
-                         kv_item, quantized, x.shape[0])
+    est = _vmem_estimate(h, kvd, f, block_s, block_f, block_q, block_o,
+                         hkv, d, w_item, kv_item, quantized, x.shape[0])
     if not _interpret() and est > _VMEM_BUDGET:
         return False
     return True
@@ -613,16 +686,24 @@ def decode_layer_step(x, w, k_cache, v_cache, lengths, k_scale=None,
                           eps=eps, hkv=hkv, d=d)
     ns = cap // block_s
 
-    def kv_map(p, b, lens):
-        return (jnp.where(p < ns, b, 0), jnp.minimum(p, ns - 1), 0, 0)
+    def kv_maps(nq):
+        def kv_map(p, b, lens):
+            in_kv = (p >= nq) & (p < nq + ns)
+            return (jnp.where(in_kv, b, 0),
+                    jnp.clip(p - nq, 0, ns - 1), 0, 0)
+        return kv_map
 
-    def sc_map(p, b, lens):
-        return (jnp.where(p < ns, b, 0), jnp.minimum(p, ns - 1), 0)
+    def sc_maps(nq):
+        def sc_map(p, b, lens):
+            in_kv = (p >= nq) & (p < nq + ns)
+            return (jnp.where(in_kv, b, 0),
+                    jnp.clip(p - nq, 0, ns - 1), 0)
+        return sc_map
 
     return _run_mega(x, w, k_cache, v_cache, k_scale, v_scale, lengths,
                      ns=ns, cap=cap, eps=eps, quantized=quantized,
-                     paged=False, kv_index_map=kv_map,
-                     sc_index_map=sc_map)
+                     paged=False, kv_map_factory=kv_maps,
+                     sc_map_factory=sc_maps)
 
 
 def decode_layer_step_paged(x, w, k_pool, v_pool, tables, lengths,
@@ -652,15 +733,22 @@ def decode_layer_step_paged(x, w, k_pool, v_pool, tables, lengths,
         return _composite(x, w, lengths, attend, quantize=quantize,
                           eps=eps, hkv=hkv, d=d)
 
-    def kv_map(p, b, tbl, lens):
-        blk = tbl[b, jnp.minimum(p, mb - 1)]
-        return (jnp.where(p < mb, blk, 0), 0, 0, 0)
+    def kv_maps(nq):
+        def kv_map(p, b, tbl, lens):
+            blk = tbl[b, jnp.clip(p - nq, 0, mb - 1)]
+            in_kv = (p >= nq) & (p < nq + mb)
+            return (jnp.where(in_kv, blk, 0), 0, 0, 0)
+        return kv_map
 
-    def sc_map(p, b, tbl, lens):
-        blk = tbl[b, jnp.minimum(p, mb - 1)]
-        return (jnp.where(p < mb, blk, 0), 0, 0)
+    def sc_maps(nq):
+        def sc_map(p, b, tbl, lens):
+            blk = tbl[b, jnp.clip(p - nq, 0, mb - 1)]
+            in_kv = (p >= nq) & (p < nq + mb)
+            return (jnp.where(in_kv, blk, 0), 0, 0)
+        return sc_map
 
     return _run_mega(x, w, k_pool, v_pool, k_scale, v_scale, lengths,
                      ns=mb, cap=mb * bs, eps=eps, quantized=quantized,
-                     paged=True, kv_index_map=kv_map, sc_index_map=sc_map,
+                     paged=True, kv_map_factory=kv_maps,
+                     sc_map_factory=sc_maps,
                      extra_scalars=(tables,))

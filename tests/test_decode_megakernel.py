@@ -118,6 +118,34 @@ def test_kernel_gate_falls_back_not_crashes():
     assert xo.shape == (B, h) and kn.shape == (B, hkv, d)
 
 
+def test_vmem_gate_admits_350m_class_config():
+    """The ISSUE-12 gate-widening satellite: with the qkv/out-proj
+    weight fetches TILED (streamed per phase instead of resident), a
+    gpt3-350m-shaped layer (h=1024, f=4096, 16 heads x 64, cap 2048,
+    bf16, 8 slots) fits the VMEM budget and runs fused — fp AND int8
+    KV — where the resident-qkv estimate used to fall back."""
+    h, hkv, d, f, cap, B = 1024, 16, 64, 4096, 2048, 8
+    kvd = hkv * d
+    shapes = [(h,), (h,), (h, h + 2 * kvd), (h + 2 * kvd,), (h, h),
+              (h,), (h,), (h,), (h, f), (f,), (f, h), (h,)]
+    w = [jnp.zeros(s, jnp.bfloat16) for s in shapes]
+    x = jnp.zeros((B, h), jnp.bfloat16)
+    block_s = mk._pick_blocks(cap, f)[0]
+    assert mk._fused_supported(x, w, hkv, d, block_s, None,
+                               jnp.bfloat16, 2, False)
+    assert mk._fused_supported(x, w, hkv, d, block_s, None,
+                               jnp.int8, 1, True)
+    # the estimate itself sits under the budget with real headroom
+    bs2, bf2, bq, bo = mk._pick_blocks(cap, f, h + 2 * kvd, h)
+    est = mk._vmem_estimate(h, kvd, f, bs2, bf2, bq, bo, hkv, d, 2, 2,
+                            False, B)
+    assert est < mk._VMEM_BUDGET
+    # a resident qkv+out accounting would NOT have fit: adding those
+    # matrices back on top of the streamed tiles blows the budget
+    resident_extra = (h * (h + 2 * kvd) + h * h) * 2
+    assert est + resident_extra > mk._VMEM_BUDGET
+
+
 # ---------------------------------------------------------------------------
 # model level: fused path ≡ composed path
 # ---------------------------------------------------------------------------

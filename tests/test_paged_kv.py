@@ -454,11 +454,15 @@ def test_bench_loadtest_smoke_contract():
     """`python bench.py --serve --loadtest --smoke` end to end: a few
     dozen Poisson arrivals with shared-prefix prompts, asserting inside
     the subprocess 0 recompiles after warmup, block pool leak-free at
-    drain (free == total) and prefix hit rate > 0."""
+    drain (free == total) and prefix hit rate > 0 — plus the ISSUE-12
+    serving-FLEET smoke that rides along (2 replicas + prefix-aware
+    router + spec decode): cache-aware routing must beat round-robin on
+    prefix hit rate AND p99 TTFT in a paired skewed-tenant run, with
+    accepted_tokens_per_tick > 1.5 and zero compiles fleet-wide."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run([sys.executable, "bench.py", "--serve",
                         "--loadtest", "--smoke"], cwd=REPO, env=env,
-                       capture_output=True, text=True, timeout=420)
+                       capture_output=True, text=True, timeout=560)
     assert p.returncode == 0, p.stderr[-3000:]
     out = json.loads(p.stdout.strip().splitlines()[-1])
     assert out["metric"] == "loadtest_smoke" and out["ok"]
@@ -466,6 +470,12 @@ def test_bench_loadtest_smoke_contract():
     assert out["kv_blocks_free_at_drain"] == out["kv_blocks_total"]
     assert out["prefix_hit_rate"] > 0
     assert out["ttft_ms_p99"] >= out["ttft_ms_p50"] > 0
+    # the fleet columns (asserted inside the subprocess; re-checked
+    # here so a silently-skipped fleet phase cannot pass)
+    assert out["fleet_replicas"] == 2
+    assert out["accepted_tokens_per_tick"] > 1.5
+    assert out["fleet_prefix_hit_rate"] > out["fleet_rr_prefix_hit_rate"]
+    assert out["fleet_ttft_ms_p99"] < out["fleet_rr_ttft_ms_p99"]
 
 
 # ---- churn soak (slow) -------------------------------------------------
