@@ -361,6 +361,8 @@ def _bench_train_body(config_name, batch, seq, steps, warmup, use_flash,
         **{k: trainer_stats[k] for k in
            ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
             "compile_ms_cold", "steps_timed",
+            # per-step wall time (profiler.StepTimer via the trainer)
+            "step_time_ms", "step_time_mean_ms",
             # collective breakdown (None when BENCH_COMM_STATS=0 or the
             # AOT analysis failed)
             "comm_ms", "comm_fraction", "comm_bytes",
@@ -863,6 +865,48 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
     return out
 
 
+def _loadtest_telemetry_smoke(obs):
+    """Telemetry columns of the loadtest smoke (ISSUE 13): the Poisson
+    window ran with spans armed, so the buffer must render a
+    per-request Chrome-trace timeline (queued/prefill/decode spans on
+    request tracks) that validates, and the process registry must emit
+    a Prometheus exposition a parser round-trips.  The trace lands next
+    to BENCH_rows.jsonl as BENCH_serve_trace.json for inspection."""
+    doc = obs.tracer().chrome_trace()
+    n_events = obs.validate_chrome_trace(doc)
+    req_names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("pid") == obs.spans.PID_REQUESTS
+                 and e["ph"] == "X"}
+    for need in ("queued", "prefill", "decode"):
+        if need not in req_names:
+            raise SystemExit(
+                f"loadtest --smoke: per-request timeline is missing "
+                f"{need!r} spans (request-track spans: "
+                f"{sorted(req_names)})")
+    trace_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serve_trace.json")
+    try:
+        obs.tracer().export(trace_path)
+    except OSError as e:
+        log(f"  trace export skipped: {e}")
+        trace_path = None
+    text = obs.registry().exposition()
+    parsed = obs.parse_exposition(text)
+    for family in ("serve_decode_ticks_total", "serve_ttft_ms",
+                   "kv_blocks_in_use", "host_syncs_total"):
+        if family not in parsed:
+            raise SystemExit(
+                f"loadtest --smoke: {family!r} missing from the "
+                f"Prometheus exposition")
+    log(f"  telemetry: {n_events} trace events "
+        f"({len(req_names)} request span kinds), "
+        f"{len(parsed)} exposition families")
+    return {"telemetry_trace_events": n_events,
+            "telemetry_trace_path": trace_path,
+            "telemetry_exposition_families": len(parsed)}
+
+
 def _fleet_smoke():
     """The serving-FLEET smoke (CPU, rides --serve --loadtest --smoke):
     2 paged replicas + the prefix-aware router + speculative decoding,
@@ -1078,6 +1122,15 @@ def bench_loadtest(smoke=False):
         f"(cold {eng.stats['compile_ms_cold']:.0f}ms)")
 
     workload = SharedPrefixWorkload(cfg.vocab_size, seed=0, **wl_kw)
+    # --smoke: spans ARMED through the measured window (ISSUE 13) — the
+    # compile/sync assertions below therefore hold with telemetry ON,
+    # and the buffer renders the per-request timeline the smoke
+    # validates.  Real measurements keep spans opt-in
+    # (PADDLE_TPU_SPANS): an un-consumed 250k-event buffer has no
+    # business inside a row that claims steady-state numbers.
+    from paddle_tpu import observability as obs
+    if smoke:
+        obs.tracer().start()
     snap = compile_counter.snapshot()
     async_dispatch.reset_host_sync_count()
     report = run_loadtest(eng, num_requests, rate_rps, workload=workload)
@@ -1130,6 +1183,7 @@ def bench_loadtest(smoke=False):
         out["metric"] = "loadtest_smoke"
         out["ok"] = True
         out["kv_blocks_free_at_drain"] = eng._alloc.num_free
+        out.update(_loadtest_telemetry_smoke(obs))
         log(f"  loadtest smoke ok: {report['tokens_generated']} tokens, "
             f"0 compiles, pool drained "
             f"{eng._alloc.num_free}/{eng._alloc.capacity} free, "
@@ -1317,6 +1371,70 @@ def _smoke_megakernel():
             "decode_hbm_bytes_per_tok": hbm}
 
 
+def _smoke_telemetry():
+    """Telemetry leg of --smoke (ISSUE 13): the unified observability
+    layer must actually EXPORT — the Prometheus exposition parses back
+    (round-trip), the span buffer renders a structurally-valid
+    Chrome-trace JSON containing the train phase spans, and the JSONL
+    snapshot writer lands its file atomically (no .tmp orphan, every
+    line valid JSON).  Runs against whatever the preceding legs put in
+    the process registry/tracer, so it exercises the real wiring, not a
+    synthetic fixture."""
+    import tempfile
+    from paddle_tpu import observability as obs
+
+    # 1) exposition round-trip: the families every --smoke run feeds
+    text = obs.registry().exposition()
+    parsed = obs.parse_exposition(text)
+    for family in ("train_steps_total", "train_step_time_ms",
+                   "host_syncs_total"):
+        if family not in parsed:
+            raise SystemExit(
+                f"bench --smoke: metric family {family!r} missing from "
+                f"the Prometheus exposition (families: "
+                f"{sorted(parsed)[:12]}...)")
+
+    # 2) chrome trace: bench_smoke armed the tracer before the train
+    # legs, so the buffer must hold train phase spans and validate
+    tr = obs.tracer()
+    doc = tr.chrome_trace()
+    n_events = obs.validate_chrome_trace(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+    if "dispatch" not in names:
+        raise SystemExit(
+            f"bench --smoke: no 'dispatch' span in the trace "
+            f"({n_events} events; names {sorted(names)[:12]})")
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "trace.json")
+        tr.export(trace_path)
+        with open(trace_path) as f:
+            obs.validate_chrome_trace(json.load(f))
+
+        # 3) atomic JSONL snapshot: two writes -> two parseable lines,
+        # no .tmp orphan next to the committed file
+        snap_path = os.path.join(td, "metrics.jsonl")
+        obs.registry().write_snapshot(snap_path)
+        obs.registry().write_snapshot(snap_path, extra={"leg": "smoke"})
+        leftovers = [p for p in os.listdir(td) if p.endswith(".tmp")]
+        if leftovers:
+            raise SystemExit(
+                f"bench --smoke: snapshot writer orphaned {leftovers}")
+        with open(snap_path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        if len(lines) != 2 or "metrics" not in lines[-1]:
+            raise SystemExit(
+                f"bench --smoke: snapshot JSONL malformed "
+                f"({len(lines)} lines)")
+    snap = obs.snapshot()
+    log(f"  telemetry smoke ok: {len(parsed)} exposition families, "
+        f"{n_events} trace events, snapshot families "
+        f"{len(snap['metrics'])}")
+    return {"telemetry_ok": True,
+            "telemetry_exposition_families": len(parsed),
+            "telemetry_trace_events": n_events,
+            "telemetry_snapshot_families": len(snap["metrics"])}
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
@@ -1324,12 +1442,18 @@ def bench_smoke():
     (the one allowed sync is the final barrier), then re-runs the same
     tiny config to measure the persistent-cache warm start, and finally
     runs the quantized-decode leg (_smoke_quantized_decode: int8 KV
-    parity within tolerance + zero recompiles after warmup).  Exits
-    non-zero on any violated invariant, so CI catches dispatch-path
-    regressions before a TPU bench ever runs."""
+    parity within tolerance + zero recompiles after warmup) plus the
+    telemetry leg (_smoke_telemetry: exposition round-trip, valid
+    chrome trace, atomic snapshot — with the span tracer ARMED through
+    all of it, so 'telemetry on' is what the other invariants are
+    proven under).  Exits non-zero on any violated invariant, so CI
+    catches dispatch-path regressions before a TPU bench ever runs."""
+    from paddle_tpu import observability as obs
+    obs.tracer().start()       # spans active through every leg
     required = ("data_wait_ms", "h2d_ms", "dispatch_ms", "sync_ms",
                 "compile_ms_cold", "steps_timed", "host_syncs_measured",
-                "prefetch_depth", "comm_ms", "comm_fraction")
+                "prefetch_depth", "comm_ms", "comm_fraction",
+                "step_time_ms")
     cold = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
                        use_flash=False, remat=False, smoke=True)
     missing = [k for k in required if k not in cold]
@@ -1346,6 +1470,7 @@ def bench_smoke():
                        use_flash=False, remat=False, smoke=True)
     qrow = _smoke_quantized_decode()
     mkrow = _smoke_megakernel()
+    trow = _smoke_telemetry()
     out = {
         "metric": "bench_smoke", "ok": True,
         "compile_ms_cold": cold["compile_ms_cold"],
@@ -1354,6 +1479,7 @@ def bench_smoke():
         **{k: cold[k] for k in required},
         **qrow,
         **mkrow,
+        **trow,
     }
     log(f"  smoke ok: cold compile {cold['compile_ms_cold']:.0f}ms, "
         f"warm {warm['compile_ms_cold']:.0f}ms, "

@@ -81,7 +81,7 @@ _LAZY_MODULES = (
     "nn", "optimizer", "io", "metric", "amp", "jit", "static",
     "distributed", "vision", "text", "hapi", "callbacks", "profiler",
     "framework", "regularizer", "linalg", "distribution", "incubate",
-    "utils", "models", "autograd", "extension", "onnx",
+    "utils", "models", "autograd", "extension", "onnx", "observability",
 )
 
 

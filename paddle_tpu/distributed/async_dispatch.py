@@ -33,13 +33,22 @@ __all__ = ["StepResult", "LazyValue", "host_sync_count",
 
 _lock = threading.Lock()
 _SYNC_COUNT = 0
+_SYNC_METRIC = None
 
 
 def record_host_sync(n: int = 1) -> None:
-    """Count a blocking host<-device read-back (or an explicit barrier)."""
-    global _SYNC_COUNT
+    """Count a blocking host<-device read-back (or an explicit barrier).
+    Mirrored into the unified metrics registry (host_syncs_total) under
+    the same lock — the fleet loadgen drives replicas on threads, and
+    an unsynchronized ``+=`` on the shared child would lose counts."""
+    global _SYNC_COUNT, _SYNC_METRIC
     with _lock:
         _SYNC_COUNT += n
+        if _SYNC_METRIC is None:
+            from ..observability import metrics as _metrics
+            _SYNC_METRIC = _metrics.counter(
+                "host_syncs_total", "blocking host<-device read-backs")
+        _SYNC_METRIC.inc(n)
 
 
 def host_sync_count() -> int:

@@ -518,16 +518,26 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None,
     integrity-checked directory format (local paths only); the default
     stays the legacy single pickle for drop-in compatibility (also the
     only format that rides hdfs:// paths)."""
+    import time as _time
+    from ..observability import metrics as _metrics
+    t0 = _time.perf_counter()
     state = snapshot_trainer(trainer, extra=extra)
     if manifest:
-        return write_checkpoint(state, path)
-    # fs backend (reference framework/io/fs.cc): local paths write
-    # fsync + tmp+rename (atomic — a killed save never corrupts), hdfs://
-    # paths stage locally and upload
-    from ..framework.fs import open_for_write
-    with open_for_write(path, "wb") as f:
-        pickle.dump(state, f)
-    return path
+        out = write_checkpoint(state, path)
+    else:
+        # fs backend (reference framework/io/fs.cc): local paths write
+        # fsync + tmp+rename (atomic — a killed save never corrupts),
+        # hdfs:// paths stage locally and upload
+        from ..framework.fs import open_for_write
+        with open_for_write(path, "wb") as f:
+            pickle.dump(state, f)
+        out = path
+    _metrics.counter("checkpoint_saves_total", "trainer checkpoints "
+                     "written", labels=("format",)).labels(
+        format="manifest" if manifest else "pickle").inc()
+    _metrics.gauge("checkpoint_save_ms", "last checkpoint save wall "
+                   "time").set((_time.perf_counter() - t0) * 1e3)
+    return out
 
 
 def load_trainer(trainer, path: str,
@@ -536,10 +546,18 @@ def load_trainer(trainer, path: str,
     trainer, resharding onto the trainer's mesh when the checkpoint was
     written on a different one (see restore_trainer's `elastic`).
     Returns the 'extra' metadata dict."""
+    import time as _time
+    from ..observability import metrics as _metrics
+    t0 = _time.perf_counter()
     state = read_checkpoint(path)
     if not isinstance(state, dict) or state.get("format") != _FORMAT:
         raise ValueError(f"{path} is not a {_FORMAT} checkpoint")
-    return restore_trainer(trainer, state, elastic=elastic)
+    out = restore_trainer(trainer, state, elastic=elastic)
+    _metrics.counter("checkpoint_restores_total",
+                     "trainer checkpoints restored").inc()
+    _metrics.gauge("checkpoint_restore_ms", "last checkpoint restore "
+                   "wall time").set((_time.perf_counter() - t0) * 1e3)
+    return out
 
 
 def latest_checkpoint(directory: str, prefix: str = "ckpt-",

@@ -197,6 +197,23 @@ class GPipeTrainer:
         self._comm: Optional[dict] = None
         self._timings = {"dispatch_ms": 0.0, "compile_ms_cold": 0.0,
                          "steps_timed": 0}
+        # unified telemetry (observability/): same registry + wall timer
+        # as SpmdTrainer, labeled trainer="gpipe"
+        from ..observability import capture as _capture
+        from ..observability import metrics as _obs_metrics
+        from ..profiler import StepTimer
+        self.step_timer = StepTimer(warmup=1)
+        self.step_timer.start()
+        self._profile = _capture.ProfileWindow.from_env(kind="train")
+        self._m_steps = _obs_metrics.counter(
+            "train_steps_total", "completed train steps",
+            labels=("trainer",)).labels(trainer="gpipe")
+        self._m_step_ms = _obs_metrics.gauge(
+            "train_step_time_ms", "last per-step wall time (host)",
+            labels=("trainer",)).labels(trainer="gpipe")
+        self._m_step_hist = _obs_metrics.histogram(
+            "train_step_ms", "per-step wall time",
+            labels=("trainer",)).labels(trainer="gpipe")
         if self.num_layers % self.pp_size:
             raise ValueError(
                 f"{self.num_layers} blocks not divisible by pp degree "
@@ -703,6 +720,8 @@ class GPipeTrainer:
         return jax.device_put(mb, NamedSharding(self.mesh, spec))
 
     def train_step(self, inputs, labels):
+        if self._profile is not None:
+            self._profile.on_step(self._step_count)
         micro_in = self._microbatch(inputs)
         micro_lab = jax.tree_util.tree_map(
             self._microbatch, labels,
@@ -735,6 +754,11 @@ class GPipeTrainer:
         # the pipeline trainer is part of the kill-and-resume story too
         from ..testing import faults as _faults
         _faults.maybe_sigterm(self._step_count)
+        self.step_timer.tick()
+        self._m_steps.inc()
+        if self.step_timer.last_ms is not None:
+            self._m_step_ms.set(self.step_timer.last_ms)
+            self._m_step_hist.observe(self.step_timer.last_ms)
         return loss
 
     @property
@@ -750,6 +774,10 @@ class GPipeTrainer:
              "reshard_restores": self._reshard_restores}
         for k, v in self._timings.items():
             s[k] = round(v, 3) if isinstance(v, float) else v
+        s["step_time_ms"] = round(self.step_timer.last_ms, 3) \
+            if self.step_timer.last_ms is not None else None
+        s["step_time_mean_ms"] = round(self.step_timer.mean_ms, 3) \
+            if self.step_timer.mean_ms is not None else None
         res = self._comm
         s["comm_ms"] = res["comm_ms"] if res else None
         s["comm_bytes"] = res["bytes"] if res else None

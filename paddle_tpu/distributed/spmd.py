@@ -38,6 +38,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
+from ..observability import capture as _capture
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
 from . import async_dispatch
 from .async_dispatch import StepResult
 from .fleet.strategy import DistributedStrategy
@@ -199,6 +202,25 @@ class SpmdTrainer:
         import threading
         self._timings_lock = threading.Lock()
         self._first_call_keys: set = set()
+
+        # unified telemetry (observability/): per-step wall timer (the
+        # once-orphaned profiler.StepTimer), registry metrics, and the
+        # PADDLE_TPU_PROFILE capture window.  Children are bound ONCE
+        # here so the per-step cost is attribute arithmetic; when the
+        # env is unset the window is a literal None (one check/step).
+        from ..profiler import StepTimer
+        self.step_timer = StepTimer(warmup=1)
+        self.step_timer.start()
+        self._profile = _capture.ProfileWindow.from_env(kind="train")
+        self._m_steps = _metrics.counter(
+            "train_steps_total", "completed train steps",
+            labels=("trainer",)).labels(trainer="spmd")
+        self._m_step_ms = _metrics.gauge(
+            "train_step_time_ms", "last per-step wall time (host)",
+            labels=("trainer",)).labels(trainer="spmd")
+        self._m_step_hist = _metrics.histogram(
+            "train_step_ms", "per-step wall time",
+            labels=("trainer",)).labels(trainer="spmd")
 
         # collective breakdown (comm_ms/comm_fraction in trainer.stats):
         # opt-in — measuring it AOT-compiles each step executable a
@@ -545,6 +567,10 @@ class SpmdTrainer:
         dt = (time.perf_counter() - t0) * 1e3
         with self._timings_lock:
             self._timings["h2d_ms"] += dt
+        tr = _spans.tracer()
+        if tr.active:
+            now = tr.now_us()
+            tr.complete("h2d", now - dt * 1e3, dt * 1e3, cat="train")
         return out
 
     def _analyze_comm(self, key, args):
@@ -576,6 +602,11 @@ class SpmdTrainer:
         else:
             self._first_call_keys.add(key)
             self._timings["compile_ms_cold"] += dt
+        tr = _spans.tracer()
+        if tr.active:
+            now = tr.now_us()
+            tr.complete("dispatch", now - dt * 1e3, dt * 1e3, cat="train",
+                        args={"key": str(key)})
         return res
 
     # ------------------------------------------------------------------
@@ -977,6 +1008,25 @@ class SpmdTrainer:
 
         return jax.jit(fwd)
 
+    @staticmethod
+    def _span_sync(dt_ms: float):
+        tr = _spans.tracer()
+        if tr.active:
+            now = tr.now_us()
+            tr.complete("sync", now - dt_ms * 1e3, dt_ms * 1e3,
+                        cat="train")
+
+    def _telemetry_step_end(self):
+        """Per-step telemetry tail: tick the wall timer and mirror it
+        into the metrics registry.  Pure host arithmetic on pre-bound
+        children — no sync, no allocation beyond the timer's float."""
+        self.step_timer.tick()
+        self._m_steps.inc()
+        last = self.step_timer.last_ms
+        if last is not None:
+            self._m_step_ms.set(last)
+            self._m_step_hist.observe(last)
+
     # ------------------------------------------------------------------
     def train_step(self, inputs, labels, return_outputs=False):
         """Run one compiled training step. inputs/labels: array, Tensor,
@@ -987,6 +1037,10 @@ class SpmdTrainer:
         outputs ride along for metric computation (hapi)."""
         from . import env as _env
         _env.heartbeat()  # launcher watchdog liveness (no-op if unset)
+        if self._profile is not None:
+            # PADDLE_TPU_PROFILE=start:stop — device capture windowed on
+            # the step counter (observability.capture)
+            self._profile.on_step(self._step_count)
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
         labels = labels if isinstance(labels, (tuple, list)) else (labels,)
         batch = self.shard_batch(tuple(inputs) + tuple(labels))
@@ -1035,17 +1089,20 @@ class SpmdTrainer:
                 t_sync = time.perf_counter()
                 self._handle_rollback(guard)
                 async_dispatch.record_host_sync()
-                self._timings["sync_ms"] += \
-                    (time.perf_counter() - t_sync) * 1e3
+                dt_sync = (time.perf_counter() - t_sync) * 1e3
+                self._timings["sync_ms"] += dt_sync
+                self._span_sync(dt_sync)
             elif guard is not None:
                 t_sync = time.perf_counter()
                 self._raise_nonfinite(
                     guard, names=["loss"] if self.fp16_scaling else None)
                 async_dispatch.record_host_sync()
-                self._timings["sync_ms"] += \
-                    (time.perf_counter() - t_sync) * 1e3
+                dt_sync = (time.perf_counter() - t_sync) * 1e3
+                self._timings["sync_ms"] += dt_sync
+                self._span_sync(dt_sync)
             from ..testing import faults as _faults
             _faults.maybe_sigterm(self._step_count)
+            self._telemetry_step_end()
             result = StepResult(loss, timings=self._timings, outputs=outs)
             return (result, outs) if return_outputs else result
         if return_outputs:
@@ -1090,6 +1147,7 @@ class SpmdTrainer:
             self.optimizer._step_count = self._step_count // self.k_steps
         from ..testing import faults as _faults
         _faults.maybe_sigterm(self._step_count)
+        self._telemetry_step_end()
         return StepResult(loss, timings=self._timings)
 
     def eval_step(self, inputs):
@@ -1245,6 +1303,14 @@ class SpmdTrainer:
         self._timings["sync_ms"] += (time.perf_counter() - t_sync) * 1e3
         for k, v in self._timings.items():
             s[k] = round(v, 3) if isinstance(v, float) else v
+        # per-step wall clock (profiler.StepTimer, warmup-excluded):
+        # step_time_ms is the figure hapi logs; mean/p50 summarize
+        s["step_time_ms"] = round(self.step_timer.last_ms, 3) \
+            if self.step_timer.last_ms is not None else None
+        s["step_time_mean_ms"] = round(self.step_timer.mean_ms, 3) \
+            if self.step_timer.mean_ms is not None else None
+        s["step_time_p50_ms"] = round(self.step_timer.p50_ms, 3) \
+            if self.step_timer.p50_ms is not None else None
 
         # collective breakdown (PADDLE_TPU_COMM_STATS / comm_stats=True):
         # per-step bytes each compiled step moves over the interconnect

@@ -452,10 +452,18 @@ class Model:
     def _run_one_epoch(self, loader, cbks, mode, accum=1, num_iters=None,
                        skip_steps=0, guard=None, epoch=0, auto_dir=None,
                        log_freq=10, prefetch_depth=0):
+        from ..observability import metrics as _obs_metrics
         from ..profiler import StepTimer
         logs = {}
         timer = StepTimer(warmup=1)
         timer.start()
+        # fit-loop wall time into the metrics registry (data + step —
+        # the trainer's own train_step_time_ms excludes data); child
+        # bound once, set per step
+        m_step = _obs_metrics.gauge(
+            "fit_step_time_ms",
+            "hapi fit per-step wall time (data wait included)",
+            labels=("mode",)).labels(mode=mode)
         for m in self._metrics:
             m.reset()
         it = iter(loader)
@@ -514,6 +522,7 @@ class Model:
                     # table); under async dispatch this is host-side
                     # time — the device view is stats["dispatch_ms"]
                     logs["step_time_ms"] = round(timer.last_ms, 3)
+                    m_step.set(timer.last_ms)
                 if step % log_freq == 0:
                     # the ONLY scheduled read-back: once per log window
                     self._resolve_logs(logs)

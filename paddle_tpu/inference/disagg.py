@@ -132,6 +132,16 @@ class DisaggServingEngine:
         self._queue: deque = deque()
         self._handoffs: deque = deque()
         self.handoffs_total = 0
+        # telemetry: the disaggregation-specific counters ride the same
+        # registry as the wrapped engine's serve_* metrics
+        from ..observability import metrics as _metrics
+        lbl = dict(engine=self.decode.telemetry_label)
+        self._m_handoffs = _metrics.counter(
+            "disagg_handoffs_total", "prefill->decode KV handoffs",
+            labels=("engine",)).labels(**lbl)
+        self._m_handoff_q = _metrics.gauge(
+            "disagg_handoff_queue", "parked handoff records",
+            labels=("engine",)).labels(**lbl)
 
     # ---- delegated surface --------------------------------------------
     @property
@@ -165,6 +175,14 @@ class DisaggServingEngine:
     @property
     def num_active(self) -> int:
         return self.decode.num_active
+
+    @property
+    def blocks_in_use(self):
+        return self.decode.blocks_in_use
+
+    @property
+    def telemetry_label(self) -> str:
+        return self.decode.telemetry_label
 
     def prefix_summary(self):
         return self.decode.prefix_summary()
@@ -217,7 +235,9 @@ class DisaggServingEngine:
             self._queue.popleft()
             self._handoffs.append(rec)
             self.handoffs_total += 1
+            self._m_handoffs.inc()
             done += 1
+        self._m_handoff_q.set(len(self._handoffs))
         # 2) admission: free slots adopt parked handoffs
         for slot in range(self.decode.batch_slots):
             if not self._handoffs or not self.decode._admitting:

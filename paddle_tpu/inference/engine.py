@@ -70,6 +70,9 @@ import jax.numpy as jnp
 
 from ..distributed import async_dispatch
 from ..func import functional_apply, functional_state
+from ..observability import capture as _capture
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
 from ..utils import compile_cache, compile_counter
 from .paged_kv import BlockAllocator, blocks_for, init_paged_cache
 from .prefix_cache import RadixPrefixCache
@@ -159,6 +162,15 @@ class Request:
 class InferenceEngine:
     """Continuous-batching serving engine for GPTForCausalLM.
 
+    Telemetry (ISSUE 13): every engine feeds the process metrics
+    registry (labeled ``engine=eN``) and, when the span tracer is armed,
+    emits the per-request lifecycle timeline — ``queued`` → ``prefill``
+    → ``decode`` spans on a per-request track plus per-tick spans
+    (preemptions as instants, speculative accept counts as tick args).
+    All of it is host-side timestamp arithmetic: telemetry adds ZERO
+    host syncs per tick and never perturbs executable shapes
+    (zero-recompile preserved — proven in tests/test_telemetry.py).
+
     Usage::
 
         eng = InferenceEngine(model, batch_slots=8, kv_layout="paged")
@@ -171,6 +183,8 @@ class InferenceEngine:
     blocking single-request form: it goes through the same admission
     queue, so on a full engine it WAITS for capacity instead of raising.
     """
+
+    _engine_ids = itertools.count()
 
     def __init__(self, model, batch_slots: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
@@ -304,6 +318,43 @@ class InferenceEngine:
         self.undelivered: List[Request] = []
         self._first_call_keys: set = set()
         self._counters0 = compile_counter.snapshot()
+
+        # unified telemetry (observability/): registry children bound
+        # ONCE per engine (per-tick cost = attribute arithmetic), the
+        # span tracer handle (gated on .active — one attr read when
+        # off), and the PADDLE_TPU_PROFILE window keyed on decode ticks.
+        self.telemetry_label = f"e{next(InferenceEngine._engine_ids)}"
+        lbl = dict(engine=self.telemetry_label)
+        self._tracer = _spans.tracer()
+        self._profile = _capture.ProfileWindow.from_env(kind="serve")
+        self._m_ticks = _metrics.counter(
+            "serve_decode_ticks_total", "decode steps/ticks",
+            labels=("engine",)).labels(**lbl)
+        self._m_tokens = _metrics.counter(
+            "serve_tokens_total", "generated tokens",
+            labels=("engine",)).labels(**lbl)
+        self._m_prefills = _metrics.counter(
+            "serve_prefills_total", "admission prefills",
+            labels=("engine",)).labels(**lbl)
+        self._m_preempts = _metrics.counter(
+            "serve_preemptions_total", "requests preempted to queue",
+            labels=("engine",)).labels(**lbl)
+        self._m_req_ok = _metrics.counter(
+            "serve_requests_total", "finished requests",
+            labels=("engine", "outcome")).labels(outcome="ok", **lbl)
+        self._m_req_to = _metrics.counter(
+            "serve_requests_total", "finished requests",
+            labels=("engine", "outcome")).labels(outcome="timed_out",
+                                                 **lbl)
+        self._m_ttft = _metrics.histogram(
+            "serve_ttft_ms", "enqueue -> first token",
+            labels=("engine",)).labels(**lbl)
+        self._m_queue = _metrics.gauge(
+            "serve_queue_depth", "queued requests",
+            labels=("engine",)).labels(**lbl)
+        self._m_active = _metrics.gauge(
+            "serve_active_slots", "occupied decode slots",
+            labels=("engine",)).labels(**lbl)
 
     # ---- paged layout setup -------------------------------------------
     def _init_paged(self, cache_dtype, kv_block_size, kv_num_blocks,
@@ -558,6 +609,17 @@ class InferenceEngine:
         req.active_s += now - req.t_live
         req.t_queue_since = now
         self._timings["preemptions"] += 1
+        self._m_preempts.inc()
+        if self._tracer.active:
+            tr = self._tracer
+            t_live = tr.to_us(req.t_live)
+            tr.complete("decode", t_live, tr.to_us(now) - t_live,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request",
+                        args={"tokens": len(req.generated),
+                              "preempted": True})
+            tr.instant("preempt", pid=_spans.PID_REQUESTS, tid=req.rid,
+                       cat="request", ts_us=tr.to_us(now))
         self._release_slot(req)
         self._queue.appendleft(req)
 
@@ -611,9 +673,26 @@ class InferenceEngine:
         now = time.perf_counter()
         if req.t_first is None:
             req.t_first = now
+            self._m_ttft.observe((now - req.t_enqueue) * 1e3)
         req.t_live = now
         req.queued_s += req.t_admit - req.t_queue_since
         self._timings["prefills"] += 1
+        self._m_prefills.inc()
+        if self._tracer.active:
+            # request-lifecycle timeline: close the queued span, record
+            # the prefill span (host timestamps already on hand — no
+            # extra clock reads beyond `now` above)
+            tr = self._tracer
+            t_q = tr.to_us(req.t_queue_since)
+            t_adm = tr.to_us(req.t_admit)
+            tr.complete("queued", t_q, t_adm - t_q,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request",
+                        args={"prompt_tokens": int(req.prompt.size),
+                              "resume": req.resume_prompt is not None})
+            tr.complete("prefill", t_adm, tr.to_us(now) - t_adm,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request", args={"slot": slot})
         req.slot = slot
         req.admit_seq = next(self._admit_counter)
         self._slots[slot] = req
@@ -835,6 +914,7 @@ class InferenceEngine:
         enough that no realistic single run() batch ever hits it."""
         self.results[req.rid] = np.asarray(req.generated, np.int32)
         self.request_stats[req.rid] = self._request_record(req)
+        (self._m_req_to if req.timed_out else self._m_req_ok).inc()
         while len(self.request_stats) > self._request_stats_cap:
             self.request_stats.pop(next(iter(self.request_stats)))
         while len(self.results) > self._results_cap:
@@ -844,6 +924,19 @@ class InferenceEngine:
         req.done = True
         req.t_finish = time.perf_counter()
         req.active_s += req.t_finish - req.t_live
+        if self._tracer.active:
+            # close the request track: the decode span of this (final)
+            # activation — together with queued/prefill/earlier decode
+            # spans this is the full lifecycle timeline
+            tr = self._tracer
+            t_live = tr.to_us(req.t_live)
+            tr.complete("decode", t_live,
+                        tr.to_us(req.t_finish) - t_live,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request",
+                        args={"tokens": len(req.generated),
+                              "preemptions": req.preemptions,
+                              "timed_out": req.timed_out})
         self._deliver(req)
         self._release_slot(req)
 
@@ -914,6 +1007,10 @@ class InferenceEngine:
         for every active slot. Returns the number of tokens produced
         this step (admission prefills included)."""
         produced = 0
+        if self._profile is not None:
+            # PADDLE_TPU_PROFILE=start:stop over DECODE TICKS
+            self._profile.on_step(self._timings["decode_steps"])
+        self._m_queue.set(len(self._queue))
         self._retire_expired()
         for slot in range(self.batch_slots):
             if not self._admitting:
@@ -945,6 +1042,9 @@ class InferenceEngine:
             self._timings["block_occupancy_sum"] += \
                 self._alloc.num_in_use / self._alloc.capacity
         self._timings["occupancy_sum"] += float(active_np.mean())
+        n_active = int(active_np.sum())
+        self._m_active.set(n_active)
+        tick_t0 = self._tracer.now_us() if self._tracer.active else 0.0
         if self.kv_layout == "paged":
             nxt, self._key, cache = self._timed(
                 "decode_ms", ("decode", 0),
@@ -970,6 +1070,13 @@ class InferenceEngine:
         async_dispatch.record_host_sync()
         self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
         self._timings["decode_steps"] += 1
+        self._m_ticks.inc()
+        self._m_tokens.inc(n_active)
+        if self._tracer.active:
+            now_us = self._tracer.now_us()
+            self._tracer.complete("decode_tick", tick_t0,
+                                  now_us - tick_t0, cat="serve",
+                                  args={"active": n_active})
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -1009,6 +1116,9 @@ class InferenceEngine:
             self._timings["block_occupancy_sum"] += \
                 self._alloc.num_in_use / self._alloc.capacity
         self._timings["occupancy_sum"] += float(active_np.mean())
+        n_active = int(active_np.sum())
+        self._m_active.set(n_active)
+        tick_t0 = self._tracer.now_us() if self._tracer.active else 0.0
         out = self._spec.tick(active_np)
         # the ONE host sync of the tick: K+1 target-greedy tokens + the
         # committed count per slot, one int32 readback
@@ -1050,6 +1160,15 @@ class InferenceEngine:
                 self._next_token[slot] = emitted[-1]
                 self._spec.after_commit(slot,
                                         np.asarray(emitted, np.int32))
+        self._m_ticks.inc()
+        self._m_tokens.inc(produced)
+        if self._tracer.active:
+            # spec accept counts per tick, as the timeline args
+            now_us = self._tracer.now_us()
+            self._tracer.complete(
+                "spec_tick", tick_t0, now_us - tick_t0, cat="serve",
+                args={"active": n_active, "committed": produced,
+                      "k": k})
         return produced
 
     def step_or_raise(self) -> int:

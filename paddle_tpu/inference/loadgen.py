@@ -26,6 +26,9 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..observability import metrics as _obs_metrics
+from ..observability.slo import SLOMonitor
+
 __all__ = ["SharedPrefixWorkload", "MultiTenantWorkload", "run_loadtest",
            "run_fleet_loadtest"]
 
@@ -62,7 +65,8 @@ class SharedPrefixWorkload:
 def run_loadtest(engine, num_requests: int, rate_rps: float,
                  workload: Optional[SharedPrefixWorkload] = None,
                  seed: int = 0, eos_id: Optional[int] = None,
-                 deadline_s: Optional[float] = None) -> dict:
+                 deadline_s: Optional[float] = None,
+                 slo_monitor: Optional[SLOMonitor] = None) -> dict:
     """Open-loop Poisson load test against a warmed engine.
 
     Arrival times are drawn up front (exponential gaps at ``rate_rps``);
@@ -176,6 +180,14 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
             1 for r in recs if r.get("timed_out")),
         "kv_layout": st["kv_layout"],
     }
+    # SLO verdict over THIS window's corrected TTFTs (threshold from
+    # PADDLE_TPU_SLO_TTFT_P99_MS / the monitor, regression vs the bench
+    # history): the observability tentpole's rolling watch, reported —
+    # never asserted — by the harness
+    mon = slo_monitor or SLOMonitor()
+    for t in ttfts:
+        mon.observe(t)
+    report["slo"] = mon.check()
     for k in ("kv_block_size", "kv_blocks_total"):
         if k in st:
             report[k] = st[k]
@@ -249,7 +261,8 @@ def warm_fleet(router, workload, passes: int = 2):
 def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
                        workload: Optional[MultiTenantWorkload] = None,
                        seed: int = 0, eos_id: Optional[int] = None,
-                       deadline_s: Optional[float] = None) -> dict:
+                       deadline_s: Optional[float] = None,
+                       slo_monitor: Optional[SLOMonitor] = None) -> dict:
     """Open-loop Poisson load test against a ROUTED fleet (a
     ``router.Router`` over warmed replicas) — the multi-replica twin of
     :func:`run_loadtest`.  Requests arrive on the Poisson clock, the
@@ -289,6 +302,15 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     order: List[tuple] = []
     recs = {}
     tenants = {}
+    # fleet aggregation: the harness consumes records out of the
+    # replicas (bounded history), so IT is the scrape point — corrected
+    # TTFTs flow into the fleet histogram + SLO monitor as they retire
+    mon = slo_monitor or SLOMonitor()
+    m_ttft = _obs_metrics.histogram(
+        "fleet_ttft_ms", "per-request time to first token",
+        labels=("replica",))
+    m_tokens = _obs_metrics.counter(
+        "fleet_tokens_total", "generated tokens", labels=("replica",))
 
     def _drain():
         for key in [k for k in pending if k[1] in
@@ -297,6 +319,9 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
             rec = replicas[ridx].request_stats.pop(rid)
             if rec["ttft_ms"] is not None:
                 rec["ttft_ms"] = round(rec["ttft_ms"] + pending[key], 3)
+                m_ttft.labels(replica=str(ridx)).observe(rec["ttft_ms"])
+                mon.observe(rec["ttft_ms"])
+            m_tokens.labels(replica=str(ridx)).inc(rec.get("tokens", 0))
             rec["replica"] = ridx
             recs[key] = rec
             replicas[ridx].results.pop(rid, None)
@@ -415,4 +440,7 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     if spec_slot_ticks:
         report["accepted_tokens_per_tick"] = round(
             spec_committed / spec_slot_ticks, 3)
+    # rolling SLO verdict for the fleet window (breach + regression
+    # flags; reported, never asserted)
+    report["slo"] = mon.check()
     return report

@@ -37,12 +37,15 @@ hundred int32s per step is noise next to the cache itself.
 """
 from __future__ import annotations
 
+import itertools
 from typing import List, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..observability import metrics as _metrics
 
 __all__ = ["PagedKVCache", "BlockAllocator", "init_paged_cache",
            "blocks_for"]
@@ -133,6 +136,8 @@ class BlockAllocator:
     pinning a node) holds a block; ``decref`` frees at zero.
     """
 
+    _ids = itertools.count()
+
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is the "
@@ -143,6 +148,15 @@ class BlockAllocator:
         # LIFO: recently-freed blocks are re-used first (their pool rows
         # are warm in cache on CPU; harmless on TPU)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        # pool pressure into the metrics registry (one gauge set per
+        # alloc/decref — attribute arithmetic on a pre-bound child)
+        pool = f"p{next(BlockAllocator._ids)}"
+        self._m_in_use = _metrics.gauge(
+            "kv_blocks_in_use", "paged KV blocks held",
+            labels=("pool",)).labels(pool=pool)
+        _metrics.gauge("kv_blocks_capacity", "allocatable pool blocks",
+                       labels=("pool",)).labels(pool=pool).set(
+            self.capacity)
 
     @property
     def capacity(self) -> int:
@@ -168,6 +182,7 @@ class BlockAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refs[b] = 1
+        self._m_in_use.set(self.capacity - len(self._free))
         return out
 
     def incref(self, blocks) -> None:
@@ -184,6 +199,7 @@ class BlockAllocator:
             self._refs[b] = r
             if r == 0:
                 self._free.append(b)
+        self._m_in_use.set(self.capacity - len(self._free))
 
     def check_leak_free(self) -> None:
         """Raise unless every block is back on the free list — the

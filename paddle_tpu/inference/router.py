@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import metrics as _metrics
 from .prefix_cache import fingerprint_chain, score_overlap
 
 __all__ = ["Router"]
@@ -71,6 +72,16 @@ class Router:
         self.requests = 0
         self.prefix_routed = 0        # routed BY a positive overlap
         self.prefix_blocks_routed = 0
+        # unified telemetry: routing decisions into the registry, and a
+        # lazily-built fleet aggregator (scrape_metrics) that folds each
+        # replica's finished-request records into fleet-level metrics
+        self._m_routed = _metrics.counter(
+            "router_requests_total", "requests placed",
+            labels=("policy",)).labels(policy=self.policy)
+        self._m_prefix_routed = _metrics.counter(
+            "router_prefix_routed_total",
+            "requests placed by prefix affinity")
+        self._aggregator = None
 
     # ---- scoring ------------------------------------------------------
     def _load(self, replica) -> int:
@@ -122,9 +133,11 @@ class Router:
                 else:
                     self.prefix_routed += 1
                     self.prefix_blocks_routed += best
+                    self._m_prefix_routed.inc()
             else:
                 idx = int(np.argmin(loads))
         self.routed[idx] += 1
+        self._m_routed.inc()
         return idx
 
     # ---- request plumbing ---------------------------------------------
@@ -165,6 +178,21 @@ class Router:
         for r in self.replicas:
             leftover.extend(r.drain(timeout_s))
         return leftover
+
+    # ---- telemetry ----------------------------------------------------
+    def scrape_metrics(self, monitor=None) -> dict:
+        """One fleet aggregation pass: fold every replica's NEW
+        finished-request records into the fleet-level registry metrics
+        (TTFT histogram, token/request counters, queue-depth and
+        block gauges per replica) and optionally feed an SLOMonitor.
+        Host-side dict reading only — safe inside a serving loop."""
+        if self._aggregator is None:
+            from ..observability import FleetAggregator
+            self._aggregator = FleetAggregator(self.replicas,
+                                               monitor=monitor)
+        elif monitor is not None:
+            self._aggregator.monitor = monitor
+        return self._aggregator.scrape()
 
     # ---- stats --------------------------------------------------------
     @property

@@ -125,8 +125,14 @@ class DevicePrefetcher:
                                 raise RuntimeError(
                                     "device prefetch thread died without "
                                     "delivering a batch")
-                self._timings["data_wait_ms"] += \
-                    (time.perf_counter() - t0) * 1e3
+                dt = (time.perf_counter() - t0) * 1e3
+                self._timings["data_wait_ms"] += dt
+                from ..observability import spans as _spans
+                tr = _spans.tracer()
+                if tr.active:
+                    now = tr.now_us()
+                    tr.complete("data_wait", now - dt * 1e3, dt * 1e3,
+                                cat="train")
                 if kind == _END:
                     return
                 if kind == _ERROR:

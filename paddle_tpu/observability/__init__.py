@@ -1,0 +1,51 @@
+"""Unified telemetry layer (reference: the platform-layer profiler /
+monitor registry — PAPER.md §1 layer 0).
+
+One sink, four capabilities, every entry point feeds it:
+
+- **metrics** — process-wide registry (counters/gauges/histograms with
+  labels, lock-free hot path), Prometheus text exposition + round-trip
+  parser, atomic JSONL snapshots.  Fed by SpmdTrainer / GPipeTrainer
+  step loops, the serving engine's decode tick, the paged allocator,
+  the router, checkpoint save/restore, the compile/trace and host-sync
+  counters, and the load harness.
+- **spans** — structured host spans (train step phases, per-request
+  serving lifecycle) exported as Chrome-trace/Perfetto JSON, nested
+  inside device captures via jax.profiler.TraceAnnotation.
+- **capture** — ``PADDLE_TPU_PROFILE=start:stop`` windows a
+  jax.profiler device trace over a step/tick range with zero
+  steady-state overhead.
+- **slo** — fleet aggregation over engine replicas + a rolling SLO
+  monitor (threshold breaches, regression vs BENCH_rows.jsonl).
+
+Invariants (proven in tests/test_telemetry.py): telemetry-on adds zero
+host syncs per decode tick and keeps the decode loop zero-recompile;
+telemetry-off adds no per-step allocations.
+"""
+from . import metrics
+from . import spans
+from .capture import ProfileWindow, parse_profile_spec
+from .metrics import (counter, gauge, histogram, parse_exposition,
+                      registry, write_snapshot)
+from .slo import FleetAggregator, SLOMonitor, load_bench_baseline
+from .spans import (export_chrome_trace, span, tracer,
+                    validate_chrome_trace)
+
+__all__ = [
+    "metrics", "spans", "counter", "gauge", "histogram", "registry",
+    "snapshot", "write_snapshot", "parse_exposition",
+    "span", "tracer", "export_chrome_trace", "validate_chrome_trace",
+    "ProfileWindow", "parse_profile_spec",
+    "FleetAggregator", "SLOMonitor", "load_bench_baseline",
+]
+
+
+def snapshot() -> dict:
+    """THE one-call answer: every registered train/serve/fleet metric,
+    JSON-safe, plus tracer state."""
+    return {
+        "metrics": metrics.snapshot(),
+        "spans": {"buffered": len(spans.tracer()),
+                  "dropped": spans.tracer().dropped,
+                  "active": spans.tracer().active},
+    }

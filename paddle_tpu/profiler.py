@@ -31,20 +31,39 @@ _active_trace_dir: Optional[str] = None
 class RecordEvent:
     """Host-side trace annotation (reference platform/profiler.h:127).
     Context manager or decorator; nests inside the device trace when a
-    capture is active, costs ~nothing when idle."""
+    capture is active, costs ~nothing when idle.
 
-    def __init__(self, name: str):
+    Two sinks per event (ISSUE 13): the jax TraceAnnotation shows the
+    span nested inside a device capture, and — when the structured span
+    tracer is armed (observability.spans) — the same enter/exit pair
+    lands in the process span buffer for Chrome-trace export, so one
+    RecordEvent instruments both the device timeline and the host
+    timeline."""
+
+    def __init__(self, name: str, args: Optional[dict] = None):
         self.name = name
+        self.args = args
         self._ann = None
+        self._t0_us = 0.0
 
     def __enter__(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        from .observability import spans as _spans
+        tr = _spans.tracer()
+        if tr.active:
+            self._t0_us = tr.now_us()
         return self
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
         self._ann = None
+        from .observability import spans as _spans
+        tr = _spans.tracer()
+        if tr.active:
+            now = tr.now_us()
+            tr.complete(self.name, self._t0_us, now - self._t0_us,
+                        cat="record_event", args=self.args)
         return False
 
     def __call__(self, fn):

@@ -25,10 +25,12 @@ from __future__ import annotations
 
 import os
 import re
+import warnings
 from typing import Dict, Optional
 
 __all__ = ["parse_hlo_collectives", "estimate_comm_ms",
-           "analyze_compiled", "analyze_jit", "COLLECTIVE_KINDS"]
+           "analyze_compiled", "analyze_jit", "empty_breakdown",
+           "COLLECTIVE_KINDS"]
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
                     "all-to-all", "collective-permute")
@@ -189,22 +191,68 @@ def estimate_comm_ms(n_bytes: int, device=None) -> float:
     return (n_bytes / bw) * 1e3 if bw > 0 else 0.0
 
 
+_warned_degraded = False
+
+
+def empty_breakdown(error: Optional[str] = None) -> Dict:
+    """The shape of "we could not measure": zero collectives, the
+    ``unavailable`` flag, and (when known) the error.  Callers that
+    stored this report comm_ms 0 with unavailable=True instead of
+    crashing mid-training."""
+    out = {"count": 0, "bytes": 0, "by_op": {}, "comm_ms": 0.0,
+           "unavailable": True}
+    if error:
+        out["error"] = error
+    return out
+
+
+def _degraded(stage: str, exc: BaseException) -> Dict:
+    """Comm stats are DIAGNOSTICS: a backend whose AOT HLO analysis
+    raises (no as_text on deserialized executables, exotic runtimes,
+    jax internals moving) must degrade the measurement, never the
+    training step.  Warn ONCE per process, count every failure in the
+    metrics registry, hand back an empty breakdown."""
+    global _warned_degraded
+    err = f"{type(exc).__name__}: {str(exc)[:200]}"
+    try:
+        from ..observability import metrics as _metrics
+        _metrics.counter("comm_stats_failures_total",
+                         "comm-stats AOT analyses that degraded",
+                         labels=("stage",)).labels(stage=stage).inc()
+    except Exception:
+        pass
+    if not _warned_degraded:
+        _warned_degraded = True
+        warnings.warn(
+            f"comm_stats: HLO analysis unavailable on this backend "
+            f"({stage}: {err}); reporting an empty collective breakdown "
+            f"(training unaffected, comm_fraction unmeasured)")
+    return empty_breakdown(err)
+
+
 def analyze_compiled(compiled, device=None) -> Dict:
     """Collective breakdown + comm_ms estimate of one compiled XLA
-    executable (a `jax.stages.Compiled`)."""
-    txt = compiled.as_text()
-    out = parse_hlo_collectives(txt)
-    out["comm_ms"] = round(estimate_comm_ms(out["bytes"], device), 4)
-    return out
+    executable (a `jax.stages.Compiled`).  Never raises: a backend
+    where ``as_text``/parsing fails yields ``empty_breakdown()`` with a
+    warn-once + failure counter instead of propagating mid-training."""
+    try:
+        txt = compiled.as_text()
+        out = parse_hlo_collectives(txt)
+        out["comm_ms"] = round(estimate_comm_ms(out["bytes"], device), 4)
+        return out
+    except Exception as e:
+        return _degraded("analyze_compiled", e)
 
 
 def analyze_jit(jitfn, *args, device=None) -> Optional[Dict]:
     """AOT lower+compile `jitfn` at `args` (values or ShapeDtypeStructs)
-    and analyze its collectives.  Returns None when lowering fails (the
-    caller's step still runs; stats just stay unmeasured) — comm stats
-    are diagnostics and must never take the training step down."""
+    and analyze its collectives.  Returns None when lowering/compiling
+    fails (the caller's step still runs; stats just stay unmeasured,
+    with a warn-once + failure counter) — comm stats are diagnostics
+    and must never take the training step down."""
     try:
-        return analyze_compiled(jitfn.lower(*args).compile(),
-                                device=device)
-    except Exception:
+        compiled = jitfn.lower(*args).compile()
+    except Exception as e:
+        _degraded("analyze_jit", e)
         return None
+    return analyze_compiled(compiled, device=device)

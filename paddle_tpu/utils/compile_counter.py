@@ -33,18 +33,23 @@ __all__ = ["install", "xla_compile_count", "xla_trace_count",
 
 _lock = threading.Lock()
 _STATE = {"installed": False, "compiles": 0, "traces": 0}
+_METRICS = {}                    # lazily-bound registry children
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 
 
 def _listener(key: str, duration: float, **kwargs) -> None:
+    # registry mirror updated under the same lock: compiles can fire
+    # from any thread, and += on a shared child is not atomic
     if key == _COMPILE_EVENT:
         with _lock:
             _STATE["compiles"] += 1
+            _METRICS["compiles"].inc()
     elif key == _TRACE_EVENT:
         with _lock:
             _STATE["traces"] += 1
+            _METRICS["traces"].inc()
 
 
 def install() -> bool:
@@ -56,6 +61,13 @@ def install() -> bool:
     with _lock:
         if _STATE["installed"]:
             return True
+        # mirror into the unified metrics registry (observability/):
+        # children bound before the listener can fire
+        from ..observability import metrics as _obs_metrics
+        _METRICS["compiles"] = _obs_metrics.counter(
+            "xla_compiles_total", "XLA backend compiles")
+        _METRICS["traces"] = _obs_metrics.counter(
+            "jaxpr_traces_total", "jaxpr traces")
         try:
             from jax._src import monitoring
             monitoring.register_event_duration_secs_listener(_listener)
