@@ -368,6 +368,12 @@ def _bench_train_body(config_name, batch, seq, steps, warmup, use_flash,
             "comm_ms", "comm_fraction", "comm_bytes",
             "comm_collectives")},
     }
+    # perf-doctor verdict over THIS row's window figures (ISSUE 14):
+    # the machine-readable "which knob next" the ROADMAP-1 triage wants
+    # attached to every measured candidate
+    from paddle_tpu.observability import doctor as _doctor
+    row["doctor"] = _doctor.diagnose(
+        {**trainer_stats, **row}, kind="train")
     _persist_row(row, kind="train")
     return row
 
@@ -829,6 +835,10 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }
+    # perf-doctor verdict for this row (observability.doctor): the
+    # engine's serving signals + this window's measured compile count
+    from paddle_tpu.observability import doctor as _doctor
+    out["doctor"] = _doctor.diagnose({**stats, **out}, kind="serve")
     log(f"  serve: {out['value']} tok/s, decode p50 "
         f"{out['step_ms_p50']}ms p95 {out['step_ms_p95']}ms, "
         f"occupancy {out['slot_occupancy']}, "
@@ -1052,6 +1062,10 @@ def _fleet_smoke():
         "fleet_replica_occupancy": a["replica_occupancy"],
         "fleet_requests_per_replica": a["requests_per_replica"],
         "fleet_tokens_per_sec": a["tokens_per_sec"],
+        # observability tentpole columns (ISSUE 14): per-replica
+        # tick-time skew verdict + the fleet doctor's knob ranking
+        "fleet_straggler": a["straggler"],
+        "fleet_doctor": a["doctor"],
     }
 
 
@@ -1435,6 +1449,71 @@ def _smoke_telemetry():
             "telemetry_snapshot_families": len(snap["metrics"])}
 
 
+def _smoke_doctor():
+    """Perf-doctor leg of --smoke (ISSUE 14): the doctor must attribute
+    a DELIBERATELY sync-heavy train loop (float(loss) read every step —
+    the classic dispatch-pipeline killer) as host-sync-bound with the
+    matching knob, and must stay SILENT on the same config driven
+    lazily — a doctor that cries wolf is worse than none."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import (SpmdTrainer, async_dispatch,
+                                        create_mesh)
+    from paddle_tpu.observability import doctor as _doctor
+
+    def build():
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 10))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        return SpmdTrainer(m, opt,
+                           lambda o, y: F.cross_entropy(o, y),
+                           mesh=create_mesh({"dp": 1}))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 10, size=(8,)).astype(np.int64)
+    n = 4
+
+    def run(sync_heavy):
+        tr = build()
+        tr.train_step(x, y)                  # warmup/compile
+        s0 = async_dispatch.host_sync_count()
+        for _ in range(n):
+            res = tr.train_step(x, y)
+            if sync_heavy:
+                float(res)                   # per-step blocking readback
+        syncs = async_dispatch.host_sync_count() - s0
+        return _doctor.diagnose(
+            {**tr.stats, "host_syncs_measured": syncs, "steps": n},
+            kind="train")
+
+    bad = run(sync_heavy=True)
+    hits = [v for v in bad if v["bottleneck"] == "host-sync-bound"]
+    if not hits:
+        raise SystemExit(
+            f"bench --smoke: doctor missed the injected sync-heavy "
+            f"config (verdicts: {[v['bottleneck'] for v in bad]})")
+    if "lazy" not in hits[0]["knob"]:
+        raise SystemExit(
+            f"bench --smoke: host-sync-bound verdict carries the wrong "
+            f"knob: {hits[0]['knob']!r}")
+    clean = run(sync_heavy=False)
+    if any(v["bottleneck"] == "host-sync-bound" for v in clean):
+        raise SystemExit(
+            f"bench --smoke: doctor flagged the CLEAN config as "
+            f"host-sync-bound ({clean})")
+    log(f"  doctor smoke ok: sync-heavy -> host-sync-bound "
+        f"(syncs/step {hits[0]['evidence']['syncs_per_step']}), "
+        f"clean -> {[v['bottleneck'] for v in clean] or 'no verdict'}")
+    return {"doctor_ok": True,
+            "doctor_sync_heavy": [v["bottleneck"] for v in bad],
+            "doctor_clean": [v["bottleneck"] for v in clean]}
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
@@ -1468,18 +1547,26 @@ def bench_smoke():
     # objects, so its first-call cost shows the compile-cache warm path
     warm = bench_train("gpt3-tiny", 2, 64, steps=2, warmup=1,
                        use_flash=False, remat=False, smoke=True)
+    # bench rows now carry the doctor field (ISSUE 14): the smoke train
+    # row must have it, even when the verdict list is empty
+    if "doctor" not in cold:
+        raise SystemExit("bench --smoke: train row lost the 'doctor' "
+                         "field")
     qrow = _smoke_quantized_decode()
     mkrow = _smoke_megakernel()
     trow = _smoke_telemetry()
+    drow = _smoke_doctor()
     out = {
         "metric": "bench_smoke", "ok": True,
         "compile_ms_cold": cold["compile_ms_cold"],
         "compile_ms_warm": warm["compile_ms_cold"],
         "compile_cache_dir": cold["compile_cache_dir"],
+        "doctor": cold["doctor"],
         **{k: cold[k] for k in required},
         **qrow,
         **mkrow,
         **trow,
+        **drow,
     }
     log(f"  smoke ok: cold compile {cold['compile_ms_cold']:.0f}ms, "
         f"warm {warm['compile_ms_cold']:.0f}ms, "

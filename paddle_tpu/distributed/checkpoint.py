@@ -189,6 +189,12 @@ def snapshot_trainer(trainer, extra: Optional[dict] = None) -> dict:
     I/O can happen on a background thread (resilience.CheckpointManager).
     """
     from ..optimizer.lr import LRScheduler
+    # park the trainer's stall watchdog for the duration of the save
+    # (and any post-training tail): a slow final checkpoint is not a
+    # wedged step loop, and the next train_step re-beats it
+    wd = getattr(trainer, "watchdog", None)
+    if wd is not None:
+        wd.idle()
     state = {
         "format": _FORMAT,
         "version": _STATE_VERSION,
@@ -337,6 +343,10 @@ def restore_trainer(trainer, state: dict,
     lr = getattr(trainer.optimizer, "_lr", None)
     if isinstance(lr, LRScheduler) and "lr_scheduler" in state:
         lr.set_state_dict(state["lr_scheduler"])
+    from ..observability import flightrec as _flightrec
+    _flightrec.note_event("checkpoint_restore",
+                          step=trainer._step_count,
+                          resharded=resharded)
     return state.get("extra", {})
 
 
@@ -425,6 +435,7 @@ def write_checkpoint(state: dict, path: str) -> str:
     if truncate_and_die:
         _rm(path)
         os.rename(tmp, path)   # committed-looking, but the shard is cut
+        _faults.flightrec_dump("ckpt_truncate")  # black box first
         os._exit(137)          # SIGKILL-style death, no cleanup
     if os.path.exists(path):
         # re-save of the same step: rename the old one aside first so
@@ -537,6 +548,10 @@ def save_trainer(trainer, path: str, extra: Optional[dict] = None,
         format="manifest" if manifest else "pickle").inc()
     _metrics.gauge("checkpoint_save_ms", "last checkpoint save wall "
                    "time").set((_time.perf_counter() - t0) * 1e3)
+    from ..observability import flightrec as _flightrec
+    _flightrec.note_event(
+        "checkpoint_save", path=str(out),
+        ms=round((_time.perf_counter() - t0) * 1e3, 2))
     return out
 
 

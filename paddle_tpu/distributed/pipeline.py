@@ -214,6 +214,13 @@ class GPipeTrainer:
         self._m_step_hist = _obs_metrics.histogram(
             "train_step_ms", "per-step wall time",
             labels=("trainer",)).labels(trainer="gpipe")
+        # flight recorder + stall watchdog (observability): crash hooks
+        # once per process; watchdog thread only when
+        # PADDLE_TPU_WATCHDOG_S arms it (checked on the first step)
+        from ..observability import flightrec as _flightrec
+        _flightrec.install()
+        self.watchdog = None
+        self._wd_checked = False
         if self.num_layers % self.pp_size:
             raise ValueError(
                 f"{self.num_layers} blocks not divisible by pp degree "
@@ -720,6 +727,16 @@ class GPipeTrainer:
         return jax.device_put(mb, NamedSharding(self.mesh, spec))
 
     def train_step(self, inputs, labels):
+        # stall watchdog (PADDLE_TPU_WATCHDOG_S): armed on first step,
+        # one heartbeat per step afterwards
+        if not self._wd_checked:
+            self._wd_checked = True
+            from ..observability import watchdog as _wd
+            t = _wd.watchdog_seconds()
+            if t is not None:
+                self.watchdog = _wd.Watchdog(t, label="gpipe_train").arm()
+        if self.watchdog is not None:
+            self.watchdog.beat()
         if self._profile is not None:
             self._profile.on_step(self._step_count)
         micro_in = self._microbatch(inputs)
@@ -754,11 +771,15 @@ class GPipeTrainer:
         # the pipeline trainer is part of the kill-and-resume story too
         from ..testing import faults as _faults
         _faults.maybe_sigterm(self._step_count)
+        _faults.maybe_hang(self._step_count)
         self.step_timer.tick()
         self._m_steps.inc()
         if self.step_timer.last_ms is not None:
             self._m_step_ms.set(self.step_timer.last_ms)
             self._m_step_hist.observe(self.step_timer.last_ms)
+        from ..observability import flightrec as _flightrec
+        _flightrec.record("train_step", dur_ms=self.step_timer.last_ms,
+                          step=self._step_count, trainer="gpipe")
         return loss
 
     @property
@@ -788,6 +809,8 @@ class GPipeTrainer:
             else 0.0
         s["comm_fraction"] = round(res["comm_ms"] / mean_step, 4) \
             if (res and mean_step > 0) else None
+        from ..observability import doctor as _doctor
+        s["doctor"] = _doctor.diagnose(s, kind="train")
         return s
 
     # ------------------------------------------------------------------

@@ -273,6 +273,17 @@ class PreemptionGuard:
             return
         self.signum = signum
         self._event.set()
+        # black box BEFORE the drain (observability.flightrec): the
+        # preemption bundle must exist even if the drain/checkpoint
+        # that follows wedges or the grace period expires.  Handlers
+        # run on the main thread between bytecodes; the dump is small
+        # host-side JSON.  Never raises.
+        try:
+            from ..observability import flightrec
+            flightrec.note_event("preemption", signum=int(signum))
+            flightrec.dump("sigterm")
+        except Exception:   # pragma: no cover - dump path broken
+            pass
 
     def install(self) -> "PreemptionGuard":
         if threading.current_thread() is not threading.main_thread():

@@ -39,8 +39,11 @@ from ..core.tensor import Tensor
 from ..func import functional_call
 from ..nn.layer_base import Layer
 from ..observability import capture as _capture
+from ..observability import doctor as _doctor
+from ..observability import flightrec as _flightrec
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
+from ..observability import watchdog as _watchdog
 from . import async_dispatch
 from .async_dispatch import StepResult
 from .fleet.strategy import DistributedStrategy
@@ -221,6 +224,12 @@ class SpmdTrainer:
         self._m_step_hist = _metrics.histogram(
             "train_step_ms", "per-step wall time",
             labels=("trainer",)).labels(trainer="spmd")
+        # flight recorder + stall watchdog (observability): crash hooks
+        # installed once per process; the watchdog thread is created on
+        # the first step only when PADDLE_TPU_WATCHDOG_S arms it
+        _flightrec.install()
+        self.watchdog: Optional[_watchdog.Watchdog] = None
+        self._wd_checked = False
 
         # collective breakdown (comm_ms/comm_fraction in trainer.stats):
         # opt-in — measuring it AOT-compiles each step executable a
@@ -762,6 +771,12 @@ class SpmdTrainer:
         bad = np.asarray(vec).any()
         if bad:
             self._rollback_count += 1
+            # post-mortem FIRST: the bundle must show the state the
+            # anomaly was detected in, not the rewound one
+            _flightrec.note_event("anomaly_rollback",
+                                  step=self._step_count,
+                                  rollback_count=self._rollback_count)
+            _flightrec.dump("rollback")
             self._restore_last_good()
         elif self._step_count % self._rollback_every == 0:
             self._capture_last_good()
@@ -1016,16 +1031,32 @@ class SpmdTrainer:
             tr.complete("sync", now - dt_ms * 1e3, dt_ms * 1e3,
                         cat="train")
 
+    def _watchdog_beat(self):
+        """Arm the stall watchdog on the first step when
+        PADDLE_TPU_WATCHDOG_S is set, then heartbeat it: one monotonic
+        store per step while armed, one cached None check otherwise."""
+        if not self._wd_checked:
+            self._wd_checked = True
+            t = _watchdog.watchdog_seconds()
+            if t is not None:
+                self.watchdog = _watchdog.Watchdog(
+                    t, label="spmd_train").arm()
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
     def _telemetry_step_end(self):
         """Per-step telemetry tail: tick the wall timer and mirror it
-        into the metrics registry.  Pure host arithmetic on pre-bound
-        children — no sync, no allocation beyond the timer's float."""
+        into the metrics registry (and the flight-recorder ring).  Pure
+        host arithmetic on pre-bound children — no sync, no allocation
+        beyond the timer's float and one bounded ring entry."""
         self.step_timer.tick()
         self._m_steps.inc()
         last = self.step_timer.last_ms
         if last is not None:
             self._m_step_ms.set(last)
             self._m_step_hist.observe(last)
+        _flightrec.record("train_step", dur_ms=last,
+                          step=self._step_count)
 
     # ------------------------------------------------------------------
     def train_step(self, inputs, labels, return_outputs=False):
@@ -1037,6 +1068,7 @@ class SpmdTrainer:
         outputs ride along for metric computation (hapi)."""
         from . import env as _env
         _env.heartbeat()  # launcher watchdog liveness (no-op if unset)
+        self._watchdog_beat()  # stall monitor (PADDLE_TPU_WATCHDOG_S)
         if self._profile is not None:
             # PADDLE_TPU_PROFILE=start:stop — device capture windowed on
             # the step counter (observability.capture)
@@ -1102,6 +1134,7 @@ class SpmdTrainer:
                 self._span_sync(dt_sync)
             from ..testing import faults as _faults
             _faults.maybe_sigterm(self._step_count)
+            _faults.maybe_hang(self._step_count)
             self._telemetry_step_end()
             result = StepResult(loss, timings=self._timings, outputs=outs)
             return (result, outs) if return_outputs else result
@@ -1147,10 +1180,16 @@ class SpmdTrainer:
             self.optimizer._step_count = self._step_count // self.k_steps
         from ..testing import faults as _faults
         _faults.maybe_sigterm(self._step_count)
+        _faults.maybe_hang(self._step_count)
         self._telemetry_step_end()
         return StepResult(loss, timings=self._timings)
 
     def eval_step(self, inputs):
+        # an eval loop is progress too: heartbeat (never arm — an
+        # eval-only user has no step loop to watch), so a post-training
+        # evaluation phase neither false-fires nor goes unwatched
+        if self.watchdog is not None:
+            self.watchdog.beat()
         inputs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
         batch = self.shard_batch(tuple(inputs))
         key = ("eval", len(inputs))
@@ -1348,6 +1387,10 @@ class SpmdTrainer:
         mean_step = (self._timings["dispatch_ms"] / steps) if steps else 0.0
         s["comm_fraction"] = round(comm_ms / mean_step, 4) \
             if (self._comm and mean_step > 0) else None
+        # perf-doctor verdict over everything above (observability.
+        # doctor): ranked [{bottleneck, evidence, knob}] — host-side
+        # dict math, the machine-readable half of the ROADMAP-1 triage
+        s["doctor"] = _doctor.diagnose(s, kind="train")
         return s
 
     @property

@@ -71,8 +71,11 @@ import jax.numpy as jnp
 from ..distributed import async_dispatch
 from ..func import functional_apply, functional_state
 from ..observability import capture as _capture
+from ..observability import doctor as _doctor
+from ..observability import flightrec as _flightrec
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
+from ..observability import watchdog as _watchdog
 from ..utils import compile_cache, compile_counter
 from .paged_kv import BlockAllocator, blocks_for, init_paged_cache
 from .prefix_cache import RadixPrefixCache
@@ -355,6 +358,13 @@ class InferenceEngine:
         self._m_active = _metrics.gauge(
             "serve_active_slots", "occupied decode slots",
             labels=("engine",)).labels(**lbl)
+        # flight recorder + stall watchdog (observability): crash hooks
+        # once per process; the watchdog thread appears on the first
+        # tick only when PADDLE_TPU_WATCHDOG_S arms it, and an engine
+        # with no work parks it (an idle server is not a stall)
+        _flightrec.install()
+        self.watchdog: Optional[_watchdog.Watchdog] = None
+        self._wd_checked = False
 
     # ---- paged layout setup -------------------------------------------
     def _init_paged(self, cache_dtype, kv_block_size, kv_num_blocks,
@@ -1002,11 +1012,31 @@ class InferenceEngine:
         return not self._draining and (
             self._guard is None or not self._guard.preempted)
 
+    def _watchdog_beat(self):
+        """Arm the stall watchdog on the first tick when
+        PADDLE_TPU_WATCHDOG_S is set, then heartbeat it."""
+        if not self._wd_checked:
+            self._wd_checked = True
+            t = _watchdog.watchdog_seconds()
+            if t is not None:
+                self.watchdog = _watchdog.Watchdog(
+                    t, label=f"decode_{self.telemetry_label}").arm()
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def _watchdog_idle_if_empty(self):
+        """Park the watchdog when the engine leaves this tick with no
+        work — a quiet server between arrivals is not a stall."""
+        if self.watchdog is not None and not self.has_work:
+            self.watchdog.idle()
+
     def step(self) -> int:
         """Admit queued requests into free slots, then decode one token
         for every active slot. Returns the number of tokens produced
         this step (admission prefills included)."""
         produced = 0
+        self._watchdog_beat()
+        tick_wall0 = time.perf_counter()
         if self._profile is not None:
             # PADDLE_TPU_PROFILE=start:stop over DECODE TICKS
             self._profile.on_step(self._timings["decode_steps"])
@@ -1026,9 +1056,12 @@ class InferenceEngine:
         active_np = np.asarray(
             [1 if r is not None else 0 for r in self._slots], np.int32)
         if not active_np.any():
+            self._watchdog_idle_if_empty()
             return produced
         if self._spec is not None:
-            return produced + self._step_spec()
+            produced += self._step_spec()
+            self._watchdog_idle_if_empty()
+            return produced
         if self.kv_layout == "paged":
             self._ensure_decode_room()
             # a preemption/memory-capped retirement may have emptied
@@ -1038,6 +1071,7 @@ class InferenceEngine:
                 [1 if r is not None else 0 for r in self._slots],
                 np.int32)
             if not active_np.any():
+                self._watchdog_idle_if_empty()
                 return produced
             self._timings["block_occupancy_sum"] += \
                 self._alloc.num_in_use / self._alloc.capacity
@@ -1087,6 +1121,16 @@ class InferenceEngine:
             produced += 1
             self._timings["tokens_generated"] += 1
             self._retire_if_done(req, tok)
+        # flight-recorder ring (host counters only — zero extra syncs)
+        # + deterministic stall injection for the watchdog tests
+        _flightrec.record(
+            "decode_tick",
+            dur_ms=(time.perf_counter() - tick_wall0) * 1e3,
+            tick=self._timings["decode_steps"], active=n_active,
+            tokens=produced)
+        from ..testing import faults as _faults
+        _faults.maybe_hang(self._timings["decode_steps"])
+        self._watchdog_idle_if_empty()
         return produced
 
     def _step_spec(self) -> int:
@@ -1169,6 +1213,11 @@ class InferenceEngine:
                 "spec_tick", tick_t0, now_us - tick_t0, cat="serve",
                 args={"active": n_active, "committed": produced,
                       "k": k})
+        _flightrec.record("spec_tick",
+                          tick=self._timings["decode_steps"],
+                          active=n_active, committed=produced, k=k)
+        from ..testing import faults as _faults
+        _faults.maybe_hang(self._timings["decode_steps"])
         return produced
 
     def step_or_raise(self) -> int:
@@ -1462,4 +1511,7 @@ class InferenceEngine:
             p50, p99 = np.percentile(ttfts, [50, 99])
             s["ttft_ms_p50"] = round(float(p50), 3)
             s["ttft_ms_p99"] = round(float(p99), 3)
+        # perf-doctor verdict over the serving signals above
+        # (observability.doctor): ranked [{bottleneck, evidence, knob}]
+        s["doctor"] = _doctor.diagnose(s, kind="serve")
         return s

@@ -26,7 +26,9 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..observability import doctor as _doctor
 from ..observability import metrics as _obs_metrics
+from ..observability import watchdog as _obs_watchdog
 from ..observability.slo import SLOMonitor
 
 __all__ = ["SharedPrefixWorkload", "MultiTenantWorkload", "run_loadtest",
@@ -201,6 +203,12 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         report["prefix_queries"] = dq
         report["prefix_hit_rate"] = round(dh / dq, 4) if dq else 0.0
         report["prefix_hit_blocks"] = pc.hit_blocks - pc_snap[2]
+    # perf-doctor verdict for the window (observability.doctor): the
+    # engine's steady signals with this window's columns layered on top
+    merged = {k: v for k, v in st.items()
+              if k not in ("per_request", "doctor")}
+    merged.update(report)
+    report["doctor"] = _doctor.diagnose(merged, kind="serve")
     return report
 
 
@@ -395,12 +403,18 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     preemptions = 0
     pq = ph = 0
     spec_committed = spec_slot_ticks = 0
+    tick_ms: List[Optional[float]] = []
     for r, snap, pc, pcs0 in zip(replicas, t_snaps, pcs, pc_snaps):
         t1 = r._timings
-        steps = max(t1["decode_steps"] - snap["decode_steps"], 1)
-        steps_total += t1["decode_steps"] - snap["decode_steps"]
+        d_steps = t1["decode_steps"] - snap["decode_steps"]
+        steps = max(d_steps, 1)
+        steps_total += d_steps
         occ.append(round(
             (t1["occupancy_sum"] - snap["occupancy_sum"]) / steps, 4))
+        # per-replica mean decode-tick wall time over THIS window — the
+        # straggler detector's input
+        tick_ms.append((t1["decode_ms"] - snap["decode_ms"]) / d_steps
+                       if d_steps > 0 else None)
         preemptions += t1.get("preemptions", 0) - snap.get("preemptions",
                                                            0)
         spec_committed += t1["spec_tokens_committed"] - \
@@ -440,7 +454,15 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     if spec_slot_ticks:
         report["accepted_tokens_per_tick"] = round(
             spec_committed / spec_slot_ticks, 3)
+    # straggler verdict: per-replica tick-time skew vs the fleet median
+    # (observability.watchdog; PADDLE_TPU_STRAGGLER_FACTOR) — a routed
+    # fleet is only as fast as its slowest member, so the report says
+    # WHICH member that is instead of burying it in a mean
+    report["straggler"] = _obs_watchdog.detect_stragglers(tick_ms)
     # rolling SLO verdict for the fleet window (breach + regression
     # flags; reported, never asserted)
     report["slo"] = mon.check()
+    # perf-doctor verdict over the fleet columns (prefix hit rate,
+    # preemptions, spec acceptance — the serving rule table)
+    report["doctor"] = _doctor.diagnose(report, kind="serve")
     return report

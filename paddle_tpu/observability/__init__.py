@@ -17,19 +17,35 @@ One sink, four capabilities, every entry point feeds it:
   steady-state overhead.
 - **slo** — fleet aggregation over engine replicas + a rolling SLO
   monitor (threshold breaches, regression vs BENCH_rows.jsonl).
+- **flightrec** — always-on bounded black box: recent step/tick ring +
+  event log dumped as an atomic post-mortem bundle (JSON + Chrome
+  trace) on unhandled exception, SIGTERM, rollback, fault kill, stall.
+- **watchdog** — monitor thread fed per-step/per-tick heartbeats; a
+  no-progress stall dumps all-thread stacks + a flightrec bundle.
+  Plus fleet straggler detection (tick-time skew vs median).
+- **doctor** — rule-based bottleneck attribution over the stats the
+  entry points already emit: ranked ``[{bottleneck, evidence, knob}]``
+  verdicts in ``trainer.stats['doctor']`` / ``engine.stats['doctor']``
+  / bench rows / loadgen reports.
 
 Invariants (proven in tests/test_telemetry.py): telemetry-on adds zero
 host syncs per decode tick and keeps the decode loop zero-recompile;
 telemetry-off adds no per-step allocations.
 """
+from . import doctor
+from . import flightrec
 from . import metrics
 from . import spans
+from . import watchdog
 from .capture import ProfileWindow, parse_profile_spec
+from .doctor import diagnose
+from .flightrec import FlightRecorder
 from .metrics import (counter, gauge, histogram, parse_exposition,
                       registry, write_snapshot)
 from .slo import FleetAggregator, SLOMonitor, load_bench_baseline
 from .spans import (export_chrome_trace, span, tracer,
                     validate_chrome_trace)
+from .watchdog import Watchdog, detect_stragglers
 
 __all__ = [
     "metrics", "spans", "counter", "gauge", "histogram", "registry",
@@ -37,6 +53,8 @@ __all__ = [
     "span", "tracer", "export_chrome_trace", "validate_chrome_trace",
     "ProfileWindow", "parse_profile_spec",
     "FleetAggregator", "SLOMonitor", "load_bench_baseline",
+    "flightrec", "FlightRecorder", "watchdog", "Watchdog",
+    "detect_stragglers", "doctor", "diagnose",
 ]
 
 

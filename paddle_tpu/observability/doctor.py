@@ -1,0 +1,281 @@
+"""Automated perf doctor: rule-based bottleneck attribution.
+
+ROADMAP item 1 ends every hardware run the same way: a human stares at
+``comm_fraction + compile counters + HBM bytes`` and decides which knob
+to turn next.  Every signal in that triage already exists in the stats
+surfaces PRs 3-13 built — this module is the triage itself, encoded:
+``diagnose(stats)`` runs a fixed rule table over the numbers a trainer
+/ engine / bench row / loadgen report already carries and emits a
+RANKED verdict list::
+
+    [{"bottleneck": "comm-bound",
+      "evidence": {"comm_fraction": 0.41, "top_op": "all-reduce"},
+      "knob": "PADDLE_TPU_OVERLAP=1 / MoELayer a2a_chunks "
+              "(PADDLE_TPU_MOE_A2A_CHUNKS) / revisit sharding stage",
+      "score": 0.41}]
+
+Rules fire only on evidence present in the dict (a missing or None
+signal skips the rule — the doctor never invents a bottleneck), scores
+normalize each signal into [0, 1]-ish "fraction of the step this
+costs" so verdicts rank across rules, and the output is JSON-safe so
+it rides ``trainer.stats['doctor']``, ``engine.stats['doctor']``,
+every bench row and the loadgen report unchanged.
+
+This is attribution, not enforcement: the doctor REPORTS.  The bench
+smoke asserts only on deliberately-injected fixtures (a sync-heavy
+loop must read host-sync-bound; a clean one must read clean).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["diagnose", "RULES", "Rule"]
+
+# thresholds, one place (tests build fixtures against these)
+COMM_FRACTION_MIN = 0.25
+DATA_WAIT_FRACTION_MIN = 0.25
+H2D_FRACTION_MIN = 0.25
+SYNCS_PER_STEP_MIN = 0.75
+SYNC_MS_FRACTION_MIN = 0.25
+# fraction rules need a real window behind them: a 3-step CPU smoke
+# whose whole wall clock is a few ms must not read as "bound" on
+# anything — the fractions are noise until the window has substance
+MIN_WINDOW_MS = 50.0
+BLOCK_OCCUPANCY_MIN = 0.85
+SPEC_ACCEPTANCE_MIN = 0.3
+PREFIX_HIT_RATE_MIN = 0.15
+PREFIX_QUERIES_MIN = 20
+SLOT_OCCUPANCY_MIN = 0.5
+
+
+def _num(stats: dict, key: str) -> Optional[float]:
+    v = stats.get(key)
+    return float(v) if isinstance(v, (int, float)) and not \
+        isinstance(v, bool) else None
+
+
+class Rule:
+    """One named check: ``check(stats)`` returns (evidence, score) when
+    it fires, None when the signal is absent or healthy."""
+
+    def __init__(self, bottleneck: str, kinds: tuple, knob: str,
+                 check: Callable[[dict], Optional[tuple]]):
+        self.bottleneck = bottleneck
+        self.kinds = kinds
+        self.knob = knob
+        self.check = check
+
+
+# ---------------------------------------------------------------------------
+# train rules
+# ---------------------------------------------------------------------------
+def _comm_bound(s: dict):
+    cf = _num(s, "comm_fraction")
+    if cf is None or cf < COMM_FRACTION_MIN:
+        return None
+    ev = {"comm_fraction": round(cf, 4)}
+    by_op = s.get("comm_by_op")
+    if isinstance(by_op, dict) and by_op:
+        top = max(by_op, key=lambda op: by_op[op].get("bytes", 0))
+        ev["top_op"] = top
+        ev["top_op_bytes"] = int(by_op[top].get("bytes", 0))
+    return ev, cf
+
+
+def _data_starved(s: dict):
+    wait = _num(s, "data_wait_ms")
+    disp = _num(s, "dispatch_ms")
+    if wait is None or disp is None or (wait + disp) < MIN_WINDOW_MS:
+        return None
+    frac = wait / (wait + disp)
+    if frac < DATA_WAIT_FRACTION_MIN:
+        return None
+    return {"data_wait_ms": round(wait, 2),
+            "dispatch_ms": round(disp, 2),
+            "data_wait_fraction": round(frac, 4)}, frac
+
+
+def _h2d_bound(s: dict):
+    h2d = _num(s, "h2d_ms")
+    disp = _num(s, "dispatch_ms")
+    if h2d is None or disp is None or (h2d + disp) < MIN_WINDOW_MS:
+        return None
+    frac = h2d / (h2d + disp)
+    if frac < H2D_FRACTION_MIN:
+        return None
+    return {"h2d_ms": round(h2d, 2), "dispatch_ms": round(disp, 2),
+            "h2d_fraction": round(frac, 4)}, frac
+
+
+def _host_sync_bound(s: dict):
+    # preferred evidence: a measured sync count over a step window
+    # (bench rows / the smoke fixture carry host_syncs_measured+steps);
+    # fallback: the trainer's cumulative sync wall-time share
+    syncs = _num(s, "host_syncs_measured")
+    steps = _num(s, "steps") or _num(s, "steps_timed")
+    if syncs is not None and steps and steps > 0:
+        per_step = syncs / steps
+        if per_step < SYNCS_PER_STEP_MIN:
+            return None
+        return {"host_syncs_measured": int(syncs), "steps": int(steps),
+                "syncs_per_step": round(per_step, 3)}, min(per_step, 2.0)
+    sync_ms = _num(s, "sync_ms")
+    disp = _num(s, "dispatch_ms")
+    if sync_ms is None or disp is None or \
+            (sync_ms + disp) < MIN_WINDOW_MS:
+        return None
+    frac = sync_ms / (sync_ms + disp)
+    if frac < SYNC_MS_FRACTION_MIN:
+        return None
+    return {"sync_ms": round(sync_ms, 2), "dispatch_ms": round(disp, 2),
+            "sync_fraction": round(frac, 4)}, frac
+
+
+def _recompile_churn(s: dict):
+    # only the POST-WARMUP delta is evidence (engine-lifetime compile
+    # counts legitimately include warmup); bench rows and the smokes
+    # carry it as xla_compiles_measured
+    n = _num(s, "xla_compiles_measured")
+    if n is None or n <= 0:
+        return None
+    return {"xla_compiles_measured": int(n)}, min(1.0, 0.5 + n / 10.0)
+
+
+# ---------------------------------------------------------------------------
+# serve rules
+# ---------------------------------------------------------------------------
+def _kv_pressure(s: dict):
+    occ = _num(s, "block_occupancy")
+    pre = _num(s, "preemptions") or 0.0
+    if (occ is None or occ < BLOCK_OCCUPANCY_MIN) and pre <= 0:
+        return None
+    ev = {}
+    if occ is not None:
+        ev["block_occupancy"] = round(occ, 4)
+    if pre:
+        ev["preemptions"] = int(pre)
+    score = max(occ or 0.0, min(1.0, 0.5 + pre / 20.0))
+    return ev, score
+
+
+def _low_spec_acceptance(s: dict):
+    acc = _num(s, "spec_acceptance_rate")
+    if acc is None or acc >= SPEC_ACCEPTANCE_MIN:
+        return None
+    ev = {"spec_acceptance_rate": round(acc, 4)}
+    apt = _num(s, "accepted_tokens_per_tick")
+    if apt is not None:
+        ev["accepted_tokens_per_tick"] = round(apt, 3)
+    return ev, 1.0 - acc
+
+
+def _prefix_cold(s: dict):
+    hit = _num(s, "prefix_hit_rate")
+    q = _num(s, "prefix_queries")
+    if hit is None or q is None or q < PREFIX_QUERIES_MIN or \
+            hit >= PREFIX_HIT_RATE_MIN:
+        return None
+    return {"prefix_hit_rate": round(hit, 4),
+            "prefix_queries": int(q)}, 0.5 * (1.0 - hit)
+
+
+def _idle_slots(s: dict):
+    occ = _num(s, "slot_occupancy")
+    pre = _num(s, "preemptions") or 0.0
+    if occ is None or occ >= SLOT_OCCUPANCY_MIN or pre > 0:
+        # preemption-driven emptiness is kv-pressure's verdict, not
+        # admission's
+        return None
+    steps = _num(s, "decode_steps")
+    if steps is None or steps < 8:      # too few ticks to call it
+        return None
+    return {"slot_occupancy": round(occ, 4),
+            "decode_steps": int(steps)}, 0.5 * (1.0 - occ)
+
+
+def _hbm_heavy_decode(s: dict):
+    # advisory: a full-precision, non-fused decode loop streams bytes
+    # the int8 cache + megakernel paths exist to cut — only worth
+    # saying when decode work actually happened
+    hbm = _num(s, "decode_hbm_bytes_per_tok")
+    steps = _num(s, "decode_steps")
+    if hbm is None or steps is None or steps < 8:
+        return None
+    kv = s.get("kv_dtype")
+    mk = s.get("decode_megakernel")
+    if kv not in (None, "dense") or mk:
+        return None                    # a byte-saver is already on
+    return {"decode_hbm_bytes_per_tok": int(hbm),
+            "kv_dtype": kv or "dense",
+            "decode_megakernel": bool(mk)}, 0.3
+
+
+RULES: List[Rule] = [
+    Rule("comm-bound", ("train",),
+         "PADDLE_TPU_OVERLAP=1 / MoELayer a2a_chunks "
+         "(PADDLE_TPU_MOE_A2A_CHUNKS) / revisit sharding stage",
+         _comm_bound),
+    Rule("data-starved", ("train",),
+         "raise prefetch_depth (PADDLE_TPU_PREFETCH_DEPTH) / add "
+         "DataLoader workers / check input storage",
+         _data_starved),
+    Rule("h2d-bound", ("train",),
+         "keep DevicePrefetcher on (PADDLE_TPU_PREFETCH_DEPTH>0) / "
+         "shrink host-side batch copies",
+         _h2d_bound),
+    Rule("host-sync-bound", ("train", "serve"),
+         "keep StepResult lazy (no per-step float(loss)/np.asarray); "
+         "read stats at log boundaries; anomaly_policy=rollback costs "
+         "1 sync/step",
+         _host_sync_bound),
+    Rule("recompile-churn", ("train", "serve"),
+         "pin shapes: prefill buckets (PADDLE_TPU_PREFILL_BUCKETS), "
+         "fixed batch/seq, persistent compile cache "
+         "(PADDLE_TPU_COMPILE_CACHE)",
+         _recompile_churn),
+    Rule("kv-pressure", ("serve",),
+         "raise PADDLE_TPU_KV_BLOCKS / int8 KV "
+         "(PADDLE_TPU_KV_DTYPE=int8) / lower max_new_tokens",
+         _kv_pressure),
+    Rule("low-spec-acceptance", ("serve",),
+         "lower spec_k (PADDLE_TPU_SPEC_K) / use a better-matched "
+         "draft model",
+         _low_spec_acceptance),
+    Rule("prefix-cold", ("serve",),
+         "enable the radix prefix cache (PADDLE_TPU_PREFIX_CACHE=1) / "
+         "prefix-aware routing (Router policy='prefix')",
+         _prefix_cold),
+    Rule("admission-bound", ("serve",),
+         "raise batch_slots (PADDLE_TPU_DECODE_SLOTS) / check arrival "
+         "rate vs capacity",
+         _idle_slots),
+    Rule("hbm-heavy-decode", ("serve",),
+         "enable the decode megakernel (PADDLE_TPU_DECODE_MEGAKERNEL=1)"
+         " / int8 KV (PADDLE_TPU_KV_DTYPE=int8)",
+         _hbm_heavy_decode),
+]
+
+
+def diagnose(stats: dict, kind: Optional[str] = None) -> List[dict]:
+    """Run the rule table over one stats dict; returns the ranked
+    verdict list (empty = no bottleneck the rules can see).  `kind`
+    restricts the table ('train' | 'serve'; loadgen reports pass
+    'serve' — their columns are the serving ones); None runs every
+    rule, letting the keys present decide."""
+    out: List[Dict] = []
+    for rule in RULES:
+        if kind is not None and kind not in rule.kinds:
+            continue
+        try:
+            hit = rule.check(stats)
+        except Exception:               # a broken rule must never take
+            continue                    # a stats read down
+        if hit is None:
+            continue
+        evidence, score = hit
+        out.append({"bottleneck": rule.bottleneck,
+                    "evidence": evidence,
+                    "knob": rule.knob,
+                    "score": round(float(score), 4)})
+    out.sort(key=lambda v: -v["score"])
+    return out

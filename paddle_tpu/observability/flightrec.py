@@ -1,0 +1,332 @@
+"""Flight recorder: an always-on black box for crashed/killed runs.
+
+PR 13 made telemetry live — metrics, spans, SLO verdicts — but all of it
+dies with the process: a hung decode tick or a preempted trainer takes
+its spans down with it, and the post-mortem is a shrug.  The reference
+framework keeps its profiler + error machinery at the PLATFORM layer,
+beside the device runtime (PAPER.md §1 layer 0), precisely so failure
+artifacts outlive the failing op.  This module is that posture for the
+host process:
+
+- **Ring**: a bounded deque of the most recent step/tick telemetry
+  snapshots (trainer steps, decode ticks — kind + wall time + the
+  counters the caller already has on host).  Recording is ``deque
+  .append`` of a small dict: no host syncs, no jax calls, O(ring) memory
+  forever (``PADDLE_TPU_FLIGHTREC_RING``, default 256 entries).
+- **Events**: a second bounded deque of notable instants — checkpoint
+  saves/restores, XLA compiles, anomaly rollbacks, preemptions,
+  injected faults — each stamped on the span-tracer clock so the ring
+  and the span buffer align.
+- **Dump**: ``dump(reason)`` writes an ATOMIC post-mortem bundle — a
+  directory staged as ``.tmp`` and renamed (the checkpoint-commit
+  idiom: a crash mid-dump never leaves a half bundle that parses) —
+  holding ``bundle.json`` (reason, ring, events, metrics snapshot,
+  all-thread stacks) and ``trace.json`` (a Chrome-trace document: the
+  span buffer tail plus the ring synthesized as spans, so the timeline
+  renders even when the tracer was never armed).
+
+Dump triggers (wired through the entry points):
+
+- unhandled exception ending the process (``install()`` chains
+  ``sys.excepthook`` / ``threading.excepthook``);
+- SIGTERM/SIGINT riding ``resilience.PreemptionGuard``;
+- ``anomaly_policy='rollback'`` firing in ``SpmdTrainer``;
+- fault-harness kills (``PADDLE_FAULT_CKPT_TRUNCATE`` hard-exit,
+  worker kills, ``PADDLE_FAULT_SIGTERM_STEP``);
+- watchdog-detected stalls (observability.watchdog).
+
+Knobs: ``PADDLE_TPU_FLIGHTREC=0`` disables recording AND dumping;
+``PADDLE_TPU_FLIGHTREC=<dir>`` (or ``PADDLE_TPU_FLIGHTREC_DIR``) names
+the dump directory (default ``$TMPDIR/paddle_tpu_flightrec``).  Dumps
+per process are capped (``_MAX_DUMPS``) so a pathological rollback loop
+cannot fill a disk with bundles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = ["FlightRecorder", "recorder", "record", "note_event", "dump",
+           "install", "enabled", "dump_dir", "load_bundle",
+           "PID_FLIGHTREC"]
+
+# chrome-trace process id for ring-synthesized spans (1=host, 2=requests)
+PID_FLIGHTREC = 3
+
+_RING_DEFAULT = 256
+_EVENTS_DEFAULT = 64
+_SPAN_TAIL_DEFAULT = 2048
+_MAX_DUMPS = 16
+
+
+def enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_FLIGHTREC", "1") != "0"
+
+
+def dump_dir() -> str:
+    """Where bundles land: PADDLE_TPU_FLIGHTREC_DIR wins, then a
+    path-valued PADDLE_TPU_FLIGHTREC, then the tmp default."""
+    d = os.environ.get("PADDLE_TPU_FLIGHTREC_DIR", "").strip()
+    if d:
+        return d
+    env = os.environ.get("PADDLE_TPU_FLIGHTREC", "").strip()
+    if env not in ("", "0", "1"):
+        return env
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_flightrec")
+
+
+def all_thread_stacks() -> Dict[str, List[str]]:
+    """{thread name (id): formatted frames} for every live thread — the
+    watchdog's stall evidence and every bundle's 'where was everyone'
+    page.  Pure interpreter introspection, safe from any thread."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, 'unknown')} ({tid})"
+        out[label] = traceback.format_stack(frame)
+    return out
+
+
+class FlightRecorder:
+    """Process-wide bounded telemetry ring + post-mortem dumper.  One
+    instance (``recorder()``); tests may build private ones."""
+
+    def __init__(self, ring: Optional[int] = None,
+                 events: int = _EVENTS_DEFAULT,
+                 span_tail: int = _SPAN_TAIL_DEFAULT):
+        if ring is None:
+            try:
+                ring = int(os.environ.get("PADDLE_TPU_FLIGHTREC_RING",
+                                          _RING_DEFAULT))
+            except ValueError:
+                ring = _RING_DEFAULT
+        self.ring: deque = deque(maxlen=max(int(ring), 1))
+        self.events: deque = deque(maxlen=max(int(events), 1))
+        self.span_tail = int(span_tail)
+        self.dumps = 0
+        self.last_dump_path: Optional[str] = None
+        self._seq = 0
+        # RLock: a SIGTERM handler dumps too, and the signal can land
+        # on the main thread while it is INSIDE another dump's critical
+        # section — a plain Lock would self-deadlock the handler.  The
+        # section only increments counters, so re-entry is harmless.
+        self._dump_lock = threading.RLock()
+        self._m_dumps = _metrics.counter(
+            "flightrec_dumps_total", "post-mortem bundles written",
+            labels=("reason",))
+
+    # ---- recording (hot path: dict build + deque append) --------------
+    def record(self, kind: str, dur_ms: Optional[float] = None,
+               **payload):
+        """One step/tick snapshot into the ring.  ``dur_ms`` lets the
+        dump synthesize a timeline span for the entry; payload must be
+        JSON-safe host scalars (the callers only have those)."""
+        now = _spans.tracer().now_us()
+        d = (dur_ms or 0.0) * 1e3
+        entry = {"kind": kind, "ts_us": round(now - d, 3),
+                 "dur_us": round(d, 3)}
+        entry.update(payload)
+        self.ring.append(entry)        # deque.append is GIL-atomic
+
+    def note_event(self, kind: str, **info):
+        """One notable instant (checkpoint, compile, rollback, fault,
+        preemption) into the bounded event log."""
+        ev = {"kind": kind, "ts_us": round(_spans.tracer().now_us(), 3),
+              "wall": time.time()}
+        ev.update(info)
+        self.events.append(ev)
+
+    # ---- bundle -------------------------------------------------------
+    def bundle(self, reason: str, extra: Optional[dict] = None) -> dict:
+        """The post-mortem document (JSON-safe)."""
+        doc = {
+            "format": "paddle_tpu.flightrec.v1",
+            "reason": reason,
+            "wall_time": time.time(),
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "ring": list(self.ring),
+            "events": list(self.events),
+            "stacks": all_thread_stacks(),
+            "metrics": _metrics.snapshot(),
+        }
+        if extra:
+            doc.update(extra)
+        return doc
+
+    def chrome_trace(self) -> dict:
+        """Chrome-trace doc for the bundle: the live span buffer's tail
+        plus the ring synthesized as 'X' spans on the flightrec track —
+        a loadable timeline even when PADDLE_TPU_SPANS was never on."""
+        tr = _spans.tracer()
+        doc = tr.chrome_trace()
+        events = doc["traceEvents"]
+        # keep metadata records, bound the payload tail
+        meta = [e for e in events if e.get("ph") == "M"]
+        tail = [e for e in events if e.get("ph") != "M"][-self.span_tail:]
+        meta.append({"name": "process_name", "ph": "M",
+                     "pid": PID_FLIGHTREC, "tid": 0,
+                     "args": {"name": "flight recorder"}})
+        ring_spans = []
+        for e in self.ring:
+            ring_spans.append({
+                "name": e["kind"], "ph": "X", "ts": max(e["ts_us"], 0.0),
+                "dur": max(e["dur_us"], 0.0), "pid": PID_FLIGHTREC,
+                "tid": 1, "cat": "flightrec",
+                "args": {k: v for k, v in e.items()
+                         if k not in ("kind", "ts_us", "dur_us")},
+            })
+        doc["traceEvents"] = meta + tail + ring_spans
+        return doc
+
+    def dump(self, reason: str, directory: Optional[str] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write one atomic bundle dir; returns its path (None when the
+        recorder is disabled or the per-process dump cap is hit).
+        Never raises — a broken dump path must not mask the failure
+        being recorded."""
+        if not enabled():
+            return None
+        with self._dump_lock:
+            if self.dumps >= _MAX_DUMPS:
+                return None
+            self.dumps += 1
+            self._seq += 1
+            seq = self._seq
+        try:
+            base = directory or dump_dir()
+            name = f"flightrec-{os.getpid()}-{seq:03d}-{reason}"
+            final = os.path.join(base, name)
+            tmp = final + ".tmp"
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, "bundle.json"), "w") as f:
+                json.dump(self.bundle(reason, extra=extra), f,
+                          default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(os.path.join(tmp, "trace.json"), "w") as f:
+                json.dump(self.chrome_trace(), f)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(final):     # same pid+seq cannot collide;
+                return None               # paranoia over clobbering
+            os.rename(tmp, final)
+            self.last_dump_path = final
+            self._m_dumps.labels(reason=reason).inc()
+            print(f"flightrec: wrote post-mortem bundle {final} "
+                  f"(reason={reason})", file=sys.stderr, flush=True)
+            return final
+        except Exception as e:  # pragma: no cover - dump path broken
+            print(f"flightrec: bundle dump failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr,
+                  flush=True)
+            return None
+
+
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, dur_ms: Optional[float] = None, **payload):
+    """Module-level ring record (the entry points' one-liner).  A
+    disabled recorder (PADDLE_TPU_FLIGHTREC=0) costs one env read."""
+    if enabled():
+        _RECORDER.record(kind, dur_ms=dur_ms, **payload)
+
+
+def note_event(kind: str, **info):
+    if enabled():
+        _RECORDER.note_event(kind, **info)
+
+
+def dump(reason: str, directory: Optional[str] = None,
+         extra: Optional[dict] = None) -> Optional[str]:
+    return _RECORDER.dump(reason, directory=directory, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+# crash hooks
+# ---------------------------------------------------------------------------
+_INSTALLED = {"done": False}
+_install_lock = threading.Lock()
+
+
+def install():
+    """Chain sys.excepthook / threading.excepthook so an unhandled
+    exception that ends the process leaves a bundle first.  Idempotent;
+    called by the trainer/engine constructors so any process using the
+    framework's entry points gets the black box for free.  The previous
+    hooks still run — this observes, it does not swallow."""
+    if not enabled():
+        return
+    with _install_lock:
+        if _INSTALLED["done"]:
+            return
+        _INSTALLED["done"] = True
+        prev_exc = sys.excepthook
+
+        def _hook(etype, value, tb):
+            note_event("unhandled_exception", type=etype.__name__,
+                       message=str(value)[:500])
+            dump("exception",
+                 extra={"exception": "".join(
+                     traceback.format_exception(etype, value, tb))[-8000:]})
+            prev_exc(etype, value, tb)
+
+        sys.excepthook = _hook
+        prev_thread = threading.excepthook
+
+        def _thook(args):
+            # a crashing non-daemon thread can take the process down
+            # too; record it, then defer to the previous hook
+            note_event("thread_exception",
+                       type=args.exc_type.__name__,
+                       thread=getattr(args.thread, "name", "?"),
+                       message=str(args.exc_value)[:500])
+            prev_thread(args)
+
+        threading.excepthook = _thook
+
+
+def load_bundle(path: str) -> dict:
+    """Read a dumped bundle dir back: {'bundle': ..., 'trace': ...}.
+    Raises on a malformed bundle — the tests' validity check."""
+    with open(os.path.join(path, "bundle.json")) as f:
+        bundle = json.load(f)
+    with open(os.path.join(path, "trace.json")) as f:
+        trace = json.load(f)
+    if bundle.get("format") != "paddle_tpu.flightrec.v1":
+        raise ValueError(f"{path}: not a flightrec bundle")
+    return {"bundle": bundle, "trace": trace}
+
+
+def find_bundles(directory: Optional[str] = None,
+                 reason: Optional[str] = None) -> List[str]:
+    """Committed bundle dirs under `directory` (default: dump_dir()),
+    oldest first; `.tmp` staging orphans are invisible."""
+    base = directory or dump_dir()
+    try:
+        names = sorted(os.listdir(base))
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        if not n.startswith("flightrec-") or n.endswith(".tmp"):
+            continue
+        if reason is not None and not n.endswith(f"-{reason}"):
+            continue
+        out.append(os.path.join(base, n))
+    return out

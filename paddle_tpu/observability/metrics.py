@@ -200,14 +200,27 @@ def _fmt_value(v: float) -> str:
     return repr(int(f)) if f == int(f) else repr(f)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash FIRST
+    (escaping the escapes), then quote and newline."""
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (backslash + newline, per the exposition
+    spec): a help string with a raw newline would split into a garbage
+    non-comment line and break every scraper."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_labels(names: Sequence[str], values: Sequence[str],
                 extra: Tuple[Tuple[str, str], ...] = ()) -> str:
     pairs = [(n, v) for n, v in zip(names, values)] + list(extra)
     if not pairs:
         return ""
-    body = ",".join(
-        '%s="%s"' % (n, str(v).replace("\\", r"\\").replace('"', r'\"')
-                     .replace("\n", r"\n")) for n, v in pairs)
+    body = ",".join('%s="%s"' % (n, _escape_label_value(v))
+                    for n, v in pairs)
     return "{" + body + "}"
 
 
@@ -258,7 +271,7 @@ class Registry:
             m = self._metrics[name]
             if not m._children:
                 continue
-            lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
             for key in sorted(m._children):
                 c = m._children[key]

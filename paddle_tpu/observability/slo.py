@@ -58,9 +58,32 @@ class FleetAggregator:
         self._m_blocks = metrics.gauge(
             "fleet_kv_blocks_in_use", "paged KV blocks in use",
             labels=("replica",))
+        self._m_tick_ms = metrics.gauge(
+            "fleet_tick_ms", "mean decode-tick wall time per replica",
+            labels=("replica",))
+
+    def _tick_ms(self) -> List[Optional[float]]:
+        """Per-replica mean decode-tick wall time (engine lifetime);
+        None for replicas without timing surfaces or with no ticks."""
+        out: List[Optional[float]] = []
+        for r in self.replicas:
+            t = getattr(r, "_timings", None)
+            if not isinstance(t, dict) or not t.get("decode_steps") \
+                    or not isinstance(t.get("decode_ms"), (int, float)):
+                out.append(None)
+                continue
+            out.append(t["decode_ms"] / t["decode_steps"])
+        return out
+
+    def stragglers(self) -> dict:
+        """Tick-time skew vs the fleet median (watchdog.
+        detect_stragglers over the replicas' live timing surfaces)."""
+        from .watchdog import detect_stragglers
+        return detect_stragglers(self._tick_ms())
 
     def scrape(self) -> dict:
-        """One aggregation pass; returns {"new_requests": n}."""
+        """One aggregation pass; returns {"new_requests": n,
+        "straggler": <detect_stragglers verdict>}."""
         new = 0
         for i, r in enumerate(self.replicas):
             lbl = str(i)
@@ -89,7 +112,13 @@ class FleetAggregator:
             blocks = getattr(r, "blocks_in_use", None)
             if blocks is not None:
                 self._m_blocks.labels(replica=lbl).set(blocks)
-        return {"new_requests": new}
+        tick_ms = self._tick_ms()
+        for i, ms in enumerate(tick_ms):
+            if ms is not None:
+                self._m_tick_ms.labels(replica=str(i)).set(ms)
+        from .watchdog import detect_stragglers
+        return {"new_requests": new,
+                "straggler": detect_stragglers(tick_ms)}
 
 
 def load_bench_baseline(rows_path: Optional[str] = None,
@@ -104,8 +133,12 @@ def load_bench_baseline(rows_path: Optional[str] = None,
                 os.path.dirname(os.path.abspath(__file__)))),
                 "BENCH_rows.jsonl")
     best = None
+    # a missing, empty, unreadable, or CORRUPT history file all mean
+    # the same thing: no baseline.  Binary garbage raises
+    # UnicodeDecodeError during line iteration (not json.loads), and a
+    # monitor constructed inside a serving loop must never die on it.
     try:
-        with open(rows_path) as f:
+        with open(rows_path, errors="replace") as f:
             for line in f:
                 try:
                     rec = json.loads(line)
@@ -116,9 +149,10 @@ def load_bench_baseline(rows_path: Optional[str] = None,
                 if "smoke" in str(rec.get("metric", "")):
                     continue            # smoke rows are not a perf record
                 v = rec.get(field)
-                if isinstance(v, (int, float)) and v > 0:
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool) and v > 0:
                     best = v if best is None else min(best, v)
-    except OSError:
+    except (OSError, ValueError):
         return None
     return best
 

@@ -1,0 +1,237 @@
+"""Stall watchdog + fleet straggler detection.
+
+A wedged step loop is the failure telemetry is worst at: nothing
+crashes, nothing logs, the metrics just stop moving.  The reference
+framework's platform layer pairs its profiler with error machinery for
+exactly this reason (PAPER.md §1 layer 0) — when progress stops you
+want evidence captured AT the stall, not reconstructed after the kill.
+
+:class:`Watchdog` is a monitor thread armed by the step loops
+(``SpmdTrainer``/``GPipeTrainer`` per train step, ``InferenceEngine``
+per decode tick).  The loop calls ``beat()`` — one ``time.monotonic``
+store — and the monitor fires when no beat lands for ``timeout_s``:
+
+- capture ALL-THREAD stacks (``sys._current_frames``) — the one
+  artifact that says WHERE the process is stuck;
+- write a flight-recorder bundle (reason ``stall``) with the stacks
+  attached, so the ring + span tail + stuck frames land in one place;
+- count it (``watchdog_stalls_total``) and, per ``on_stall``:
+  ``"dump"`` (default) records and keeps watching, ``"raise"``
+  additionally interrupts the main thread (KeyboardInterrupt at the
+  stall site — a deliberately blunt instrument for harnesses that
+  prefer death to a silent hang), or a callable gets the stall dict.
+
+``idle()`` parks the watchdog (an empty serving engine between
+requests is NOT a stall); the next ``beat()`` re-arms it.  A stall
+fires ONCE per episode — the next beat resets the trigger.
+
+Armed via ``PADDLE_TPU_WATCHDOG_S=<seconds>`` (unset/0 = off;
+``PADDLE_TPU_WATCHDOG_ACTION=dump|raise``).  The per-step cost when
+armed is one monotonic read + one attribute store.
+
+Straggler detection is the fleet-level twin: a replica whose per-tick
+wall time sits far above the fleet median drags every batch it serves.
+:func:`detect_stragglers` turns per-replica mean tick times into a
+verdict dict (median, ratios, flagged indexes) that
+``run_fleet_loadtest`` and ``FleetAggregator`` surface in their
+reports (``PADDLE_TPU_STRAGGLER_FACTOR``, default 1.75).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Union
+
+from . import flightrec as _flightrec
+from . import metrics as _metrics
+
+__all__ = ["Watchdog", "watchdog_seconds", "detect_stragglers"]
+
+_STRAGGLER_FACTOR_DEFAULT = 1.75
+
+
+def watchdog_seconds() -> Optional[float]:
+    """The armed timeout from PADDLE_TPU_WATCHDOG_S, or None (off)."""
+    v = os.environ.get("PADDLE_TPU_WATCHDOG_S", "").strip()
+    if not v:
+        return None
+    try:
+        t = float(v)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+class Watchdog:
+    """No-progress monitor for one step/tick loop.
+
+    Usage (what the trainers/engine do)::
+
+        wd = Watchdog(timeout_s=30, label="spmd_train").arm()
+        while training:
+            wd.beat()
+            train_step(...)
+        wd.disarm()
+    """
+
+    def __init__(self, timeout_s: float, label: str = "loop",
+                 on_stall: Union[str, Callable, None] = None,
+                 poll_s: Optional[float] = None,
+                 dump_dir: Optional[str] = None):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got "
+                             f"{timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.label = label
+        if on_stall is None:
+            on_stall = os.environ.get("PADDLE_TPU_WATCHDOG_ACTION",
+                                      "dump").strip() or "dump"
+        if isinstance(on_stall, str) and on_stall not in ("dump",
+                                                          "raise"):
+            raise ValueError(
+                f"on_stall must be 'dump', 'raise' or a callable, got "
+                f"{on_stall!r}")
+        self.on_stall = on_stall
+        # poll fast enough that detection lands well inside the
+        # configured window (stall seen within ~1.25 * timeout)
+        self.poll_s = poll_s if poll_s is not None \
+            else max(min(self.timeout_s / 4.0, 1.0), 0.01)
+        self.dump_dir = dump_dir
+        self.stalls = 0
+        self.last_stall: Optional[dict] = None
+        self._last_beat = time.monotonic()
+        self._idle = True            # not a stall until the first beat
+        self._fired = False          # one dump per stall episode
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_stalls = _metrics.counter(
+            "watchdog_stalls_total", "no-progress stalls detected",
+            labels=("label",)).labels(label=label)
+
+    # ---- loop-side API (hot path) -------------------------------------
+    def beat(self):
+        """Heartbeat: the loop made progress (or is about to do a
+        bounded unit of work).  Re-arms after idle() and closes a fired
+        stall episode."""
+        self._last_beat = time.monotonic()
+        self._idle = False
+        self._fired = False
+
+    def idle(self):
+        """No work to do — a quiet engine is not a stall."""
+        self._idle = True
+
+    # ---- lifecycle ----------------------------------------------------
+    def arm(self) -> "Watchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, name=f"watchdog-{self.label}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def disarm(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.poll_s + 1.0)
+            self._thread = None
+
+    @property
+    def stalled(self) -> bool:
+        return self._fired
+
+    # ---- monitor thread ----------------------------------------------
+    def _monitor(self):
+        while not self._stop.wait(self.poll_s):
+            if self._idle or self._fired:
+                continue
+            age = time.monotonic() - self._last_beat
+            if age <= self.timeout_s:
+                continue
+            self._fired = True
+            self._handle_stall(age)
+
+    def _handle_stall(self, age_s: float):
+        self.stalls += 1
+        self._m_stalls.inc()
+        stacks = _flightrec.all_thread_stacks()
+        info = {"label": self.label, "age_s": round(age_s, 3),
+                "timeout_s": self.timeout_s, "stacks": stacks}
+        _flightrec.note_event("watchdog_stall", label=self.label,
+                              age_s=round(age_s, 3),
+                              timeout_s=self.timeout_s)
+        path = _flightrec.dump("stall", directory=self.dump_dir,
+                               extra={"stall": {
+                                   "label": self.label,
+                                   "age_s": round(age_s, 3),
+                                   "timeout_s": self.timeout_s}})
+        info["bundle"] = path
+        self.last_stall = info
+        if callable(self.on_stall):
+            try:
+                self.on_stall(info)
+            except Exception:       # a broken callback must not kill
+                pass                # the monitor thread
+        elif self.on_stall == "raise":
+            import _thread
+            _thread.interrupt_main()
+
+
+# ---------------------------------------------------------------------------
+# fleet straggler detection
+# ---------------------------------------------------------------------------
+def straggler_factor() -> float:
+    v = os.environ.get("PADDLE_TPU_STRAGGLER_FACTOR", "").strip()
+    try:
+        return float(v) if v else _STRAGGLER_FACTOR_DEFAULT
+    except ValueError:
+        return _STRAGGLER_FACTOR_DEFAULT
+
+
+def detect_stragglers(per_replica_ms: Sequence[Optional[float]],
+                      factor: Optional[float] = None,
+                      min_ms: float = 0.05) -> dict:
+    """Per-replica step/tick-time skew vs the fleet median.
+
+    ``per_replica_ms[i]`` is replica i's mean step/tick wall time over
+    the measured window (None = replica did no work).  A replica is a
+    straggler when its mean exceeds ``factor`` x the median of its
+    PEERS (leave-one-out: a 2-replica fleet's overall median is
+    dragged halfway to the straggler itself, which would hide exactly
+    the skew the detector exists for) AND the absolute gap clears
+    ``min_ms`` (sub-jitter skew on a fast CPU harness is noise, not a
+    verdict).  Returns the report block::
+
+        {"median_ms", "factor", "per_replica_ms", "ratio",
+         "stragglers": [replica indexes]}
+
+    ``median_ms``/``ratio`` quote the all-replica median (the number a
+    dashboard plots); the flagging itself is leave-one-out.
+    """
+    import numpy as np
+    factor = float(factor) if factor is not None else straggler_factor()
+    vals = [(i, float(v)) for i, v in enumerate(per_replica_ms)
+            if v is not None and v > 0]
+    out = {"factor": factor,
+           "per_replica_ms": [round(float(v), 3) if v is not None
+                              else None for v in per_replica_ms],
+           "median_ms": None, "ratio": None, "stragglers": []}
+    if not vals:
+        return out
+    med = float(np.median([v for _, v in vals]))
+    out["median_ms"] = round(med, 3)
+    if med <= 0:
+        return out
+    valid = dict(vals)
+    out["ratio"] = [round(valid[i] / med, 3) if i in valid else None
+                    for i in range(len(per_replica_ms))]
+    if len(vals) < 2:
+        return out                  # no peers, no verdict
+    for i, v in vals:
+        peers = float(np.median([pv for pi, pv in vals if pi != i]))
+        if peers > 0 and v > factor * peers and (v - peers) > min_ms:
+            out["stragglers"].append(i)
+    return out

@@ -38,6 +38,13 @@ PADDLE_FAULT_FS_DELAY_MS="op:ms[,op2:ms2...]"
     Sleep ms milliseconds before each matching filesystem op ("*"
     matches any) — deterministic slow-storage jitter for checkpoint
     commit / delayed-write tests.  Composes with PADDLE_FAULT_FS.
+PADDLE_FAULT_HANG="step:seconds"
+    The calling loop stalls (time.sleep) for `seconds` right after
+    train step / decode tick number `step` (1-indexed, once per
+    process) — a deterministic no-progress hang for the observability
+    watchdog's stall-detection tests.  The sleep happens ON the step
+    loop's thread, exactly like a wedged collective or a dead remote
+    store would.
 """
 from __future__ import annotations
 
@@ -49,7 +56,8 @@ from typing import Optional
 
 __all__ = ["InjectedFault", "maybe_fail_fs", "nan_poison_step",
            "maybe_kill_worker", "maybe_sigterm", "reset",
-           "ckpt_truncate_commit", "mesh_shrink", "maybe_delay_fs"]
+           "ckpt_truncate_commit", "mesh_shrink", "maybe_delay_fs",
+           "maybe_hang", "flightrec_dump"]
 
 
 class InjectedFault(IOError):
@@ -62,15 +70,30 @@ _lock = threading.Lock()
 _fs_counts: dict = {}
 _sigterm_fired = False
 _ckpt_commits = 0
+_hang_fired = False
 
 
 def reset():
     """Clear all injection counters (tests call this between cases)."""
-    global _sigterm_fired, _ckpt_commits
+    global _sigterm_fired, _ckpt_commits, _hang_fired
     with _lock:
         _fs_counts.clear()
         _sigterm_fired = False
         _ckpt_commits = 0
+        _hang_fired = False
+
+
+def flightrec_dump(reason: str):
+    """Best-effort flight-recorder bundle before a fault point kills
+    the process: the injected death should leave the same black box a
+    real one would.  Never raises — a broken dump path must not change
+    the fault's semantics."""
+    try:
+        from ..observability import flightrec
+        flightrec.note_event("injected_fault", reason=reason)
+        flightrec.dump(reason)
+    except Exception:
+        pass
 
 
 def _parse_fs_spec(spec: str):
@@ -139,6 +162,7 @@ def maybe_kill_worker(worker_id: int, batches_done: int):
     except ValueError:
         return
     if worker_id == w and batches_done >= after_n:
+        flightrec_dump("worker_kill")
         os._exit(137)
 
 
@@ -210,3 +234,22 @@ def maybe_sigterm(step: int):
     if step >= k:
         _sigterm_fired = True
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_hang(step: int):
+    """Fault point in the step/tick loops (PADDLE_FAULT_HANG=
+    "step:seconds"): stall the CALLING thread for `seconds` right
+    after step/tick `step` completes, once per process — the
+    deterministic no-progress hang the watchdog tests arm."""
+    global _hang_fired
+    spec = os.environ.get("PADDLE_FAULT_HANG")
+    if not spec or _hang_fired:
+        return
+    k_s, _, secs_s = spec.partition(":")
+    try:
+        k, secs = int(k_s), float(secs_s)
+    except ValueError:
+        return
+    if step >= k and secs > 0:
+        _hang_fired = True
+        time.sleep(secs)

@@ -46,6 +46,13 @@ def _listener(key: str, duration: float, **kwargs) -> None:
         with _lock:
             _STATE["compiles"] += 1
             _METRICS["compiles"].inc()
+        # flight-recorder event log: "what compiled, when" is exactly
+        # the post-mortem question a recompile-churn hang raises.
+        # Compiles are rare after warmup, so this is a cold path.
+        from ..observability import flightrec as _flightrec
+        _flightrec.note_event("xla_compile",
+                              n=_STATE["compiles"],
+                              duration_s=round(float(duration), 4))
     elif key == _TRACE_EVENT:
         with _lock:
             _STATE["traces"] += 1

@@ -108,7 +108,10 @@ def test_launch_elastic_shrink_relaunch(tmp_path):
 import os, sys
 world = int(os.environ["PADDLE_TRAINERS_NUM"])
 rank = int(os.environ["PADDLE_TRAINER_ID"])
-print("WORLD", world, "RANK", rank, flush=True)
+# ONE pre-joined write: both ranks share the launcher's stdout pipe,
+# and multi-arg print becomes several write()s when unbuffered -- the
+# interleaved "WORLDWORLD  22" flake the assertion below trips on
+print(f"WORLD {{world}} RANK {{rank}}", flush=True)
 if world == 2:
     if rank == 0:
         with open({str(hosts)!r}, "w") as f:

@@ -118,6 +118,62 @@ def test_exposition_round_trips_through_parser():
     assert by_name["h_ms_count"][0][1] == 1
 
 
+def test_exposition_escapes_hostile_label_values_and_help():
+    """ISSUE 14 satellite: backslashes, quotes, and newlines in label
+    values AND in metric help text must render escaped and round-trip
+    through the parser — a raw newline in a HELP line used to split
+    into a garbage sample line and break the whole scrape."""
+    hostiles = ['back\\slash', 'a"b', 'nl\nx', 'end\\', 'mix\\"q\n,=}{',
+                'tab\tv', '{br}ace']
+    for h in hostiles:
+        r = Registry()
+        r.counter("t_total", 'help with\nnewline, \\ and "quotes"',
+                  labels=("k",)).labels(k=h).inc(2)
+        r.histogram("h_ms", "hist\nhelp", labels=("k",),
+                    buckets=(1.0, 10.0)).labels(k=h).observe(3.0)
+        text = r.exposition()
+        # the exposition itself must not contain a raw-newline-split
+        # garbage line (every line is a comment or parses as a sample)
+        parsed = obs.parse_exposition(text)
+        name, labels, value = parsed["t_total"]["samples"][0]
+        assert labels == {"k": h} and value == 2
+        hist = {n: v for n, lbl, v in parsed["h_ms"]["samples"]
+                if lbl.get("k") == h and n == "h_ms_count"}
+        assert hist["h_ms_count"] == 1
+
+
+def test_load_bench_baseline_missing_empty_corrupt(tmp_path):
+    """ISSUE 14 satellite: a missing, empty, or corrupt (binary
+    garbage) BENCH_rows.jsonl yields a clean no-baseline verdict —
+    never an exception out of a serving loop."""
+    # missing
+    assert load_bench_baseline(str(tmp_path / "nope.jsonl")) is None
+    # empty
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert load_bench_baseline(str(empty)) is None
+    # corrupt: binary garbage raises UnicodeDecodeError during line
+    # iteration without the hardening
+    corrupt = tmp_path / "corrupt.jsonl"
+    corrupt.write_bytes(b"\xff\xfe\x00garbage\x80\x81\nmore\xff\n")
+    assert load_bench_baseline(str(corrupt)) is None
+    # half-corrupt: the valid row is still found past garbage lines
+    mixed = tmp_path / "mixed.jsonl"
+    mixed.write_bytes(
+        b"\x80bad\n" +
+        json.dumps({"kind": "loadtest", "metric": "gpt_serve_loadtest",
+                    "ttft_ms_p99": 12.5}).encode() + b"\n{half")
+    assert load_bench_baseline(str(mixed)) == 12.5
+    # SLOMonitor built over each of them: clean "no baseline" verdict
+    for path in (tmp_path / "nope.jsonl", empty, corrupt):
+        mon = SLOMonitor(rows_path=str(path))
+        assert mon.baseline_ttft_p99_ms is None
+        mon.observe(50.0)
+        v = mon.check()
+        assert v["regressed"] is False
+        assert v["baseline_ttft_p99_ms"] is None
+
+
 def test_snapshot_jsonl_is_atomic(tmp_path):
     r = Registry()
     r.counter("c_total").inc(5)
