@@ -110,24 +110,16 @@ def _measured_rows(kind) -> dict:
     return out
 
 
-# peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
-# NB: v5e's headline 394 TFLOPS is the INT8 number; bf16 peak is 197.
-_PEAK_BF16 = {
-    "v5 lite": 197e12, "v5e": 197e12,
-    "v5p": 459e12, "v5": 459e12,
-    "v4": 275e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-    "v3": 61.5e12,  # per chip-half (device == core on v3)
-    "v2": 22.5e12,
-}
-
-
 def peak_flops(device) -> float:
+    """Peak dense bf16 FLOP/s for a device.  The per-kind table now
+    lives in the executable observatory
+    (observability.exec_registry.PEAK_FLOPS_BF16, alongside the HBM
+    bandwidth/capacity tables the roofline needs); MFU keeps its old
+    contract — 0.0 on unknown/host kinds, never a nominal figure."""
+    from paddle_tpu.observability import exec_registry as _er
     kind = getattr(device, "device_kind", "").lower()
-    for key in sorted(_PEAK_BF16, key=len, reverse=True):
-        if key in kind:
-            return _PEAK_BF16[key]
-    return 0.0
+    peak, nominal = _er.peak_flops(kind)
+    return 0.0 if nominal else peak
 
 
 def _flash_blocks(seq, head_dim, causal=True):
@@ -322,6 +314,21 @@ def _bench_train_body(config_name, batch, seq, steps, warmup, use_flash,
     # counters, so re-evaluating it per key would pollute sync_ms
     trainer_stats = trainer.stats
 
+    # executable observatory (ISSUE 15): run the deferred XLA cost/
+    # memory analyses for this trainer's executables — an AOT re-lower
+    # the persistent cache serves as a deserialize, AFTER the measured
+    # window so the compile/sync budgets above are untouched — and
+    # attach the roofline digest (flops, bytes, achieved-vs-peak, MFU
+    # attribution) to the row.  BENCH_EXEC_PROFILE=0 disables.
+    exec_profile = None
+    if os.environ.get("BENCH_EXEC_PROFILE", "1") != "0":
+        try:
+            from paddle_tpu.observability import exec_registry as _er
+            _er.analyze_all(trainer._exec_component)
+            exec_profile = _er.profile(trainer._exec_component)
+        except Exception as e:
+            log(f"  exec profile skipped: {type(e).__name__}: {e}")
+
     step_ms = dt / steps * 1e3
     tokens_per_sec = batch * seq * steps / dt
     flops_tok = cfg.flops_per_token(seq)
@@ -368,12 +375,16 @@ def _bench_train_body(config_name, batch, seq, steps, warmup, use_flash,
             "comm_ms", "comm_fraction", "comm_bytes",
             "comm_collectives")},
     }
+    # per-executable roofline digest (observability.exec_registry): the
+    # MFU-attribution evidence ROADMAP item 1's hardware run reads
+    row["exec_profile"] = exec_profile
     # perf-doctor verdict over THIS row's window figures (ISSUE 14):
     # the machine-readable "which knob next" the ROADMAP-1 triage wants
     # attached to every measured candidate
     from paddle_tpu.observability import doctor as _doctor
     row["doctor"] = _doctor.diagnose(
-        {**trainer_stats, **row}, kind="train")
+        {**trainer_stats, **row, "exec_profile": exec_profile},
+        kind="train")
     _persist_row(row, kind="train")
     return row
 
@@ -869,6 +880,18 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         out["ok"] = True
         log(f"  serve smoke ok: {total_tokens} tokens, 0 compiles, "
             f"{syncs} syncs/{budget} budget")
+    # executable observatory (ISSUE 15): analyze AFTER the measured
+    # window + smoke assertions (the AOT re-lower is a compile the
+    # 0-compile contract must not see) and attach the per-executable
+    # roofline digest to the serve row
+    out["exec_profile"] = None
+    if os.environ.get("BENCH_EXEC_PROFILE", "1") != "0":
+        try:
+            from paddle_tpu.observability import exec_registry as _er
+            _er.analyze_all(eng._exec_component)
+            out["exec_profile"] = _er.profile(eng._exec_component)
+        except Exception as e:
+            log(f"  exec profile skipped: {type(e).__name__}: {e}")
     _persist_row(out, kind="serve")
     if emit:
         print(json.dumps(out))
@@ -1514,6 +1537,85 @@ def _smoke_doctor():
             "doctor_clean": [v["bottleneck"] for v in clean]}
 
 
+def _smoke_exec_profile(train_row):
+    """Executable-observatory leg of --smoke (ISSUE 15): the train row
+    must carry an exec_profile whose train_step digest has flops /
+    bytes / roofline fields populated; a serve-side engine must produce
+    the same for its decode executable; and the report CLI must exit 0
+    rendering a snapshot written by this process — the registry
+    round-trips offline."""
+    import subprocess
+    import tempfile
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import exec_registry as _er
+
+    prof = train_row.get("exec_profile")
+    ts = (prof or {}).get("train_step")
+    if not ts:
+        raise SystemExit(
+            "bench --smoke: train row carries no exec_profile."
+            "train_step digest")
+    for fld in ("flops", "bytes_accessed", "arithmetic_intensity",
+                "bound", "mfu", "mean_ms"):
+        if ts.get(fld) in (None, ""):
+            raise SystemExit(
+                f"bench --smoke: train exec_profile missing {fld!r} "
+                f"(got {sorted(k for k, v in ts.items() if v is not None)})")
+
+    # serve leg: a tiny engine's decode executable through the same path
+    cfg = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64,
+                    use_flash_attention=False)
+    paddle.seed(0)
+    m = GPTForCausalLM(cfg)
+    eng = InferenceEngine(m, batch_slots=2, prefill_buckets=[16])
+    eng.warmup(buckets=[16])
+    rid = eng.add_request(np.arange(1, 8, dtype=np.int32),
+                          max_new_tokens=8)
+    eng.run()
+    _er.analyze_all(eng._exec_component)
+    sprof = _er.profile(eng._exec_component) or {}
+    dec = sprof.get("decode") or sprof.get("megakernel_decode")
+    if not dec:
+        raise SystemExit("bench --smoke: serve exec_profile has no "
+                         "decode digest")
+    for fld in ("flops", "bytes_accessed", "bound", "hbm_bw_frac"):
+        if dec.get(fld) in (None, ""):
+            raise SystemExit(
+                f"bench --smoke: decode exec_profile missing {fld!r}")
+
+    # snapshot -> report CLI round-trip (offline rendering, exit 0)
+    with tempfile.TemporaryDirectory() as td:
+        snap_path = os.path.join(td, "snapshot.jsonl")
+        obs.write_snapshot(snap_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability.report",
+             "--snapshot", snap_path],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"bench --smoke: report CLI exited "
+                f"{proc.returncode}:\n{proc.stderr[-2000:]}")
+        if "decode" not in proc.stdout or "hbm ledger" not in proc.stdout:
+            raise SystemExit(
+                f"bench --smoke: report CLI output missing the "
+                f"registry/ledger tables:\n{proc.stdout[:2000]}")
+    n_exec = len(_er.registry().entries())
+    log(f"  exec-profile smoke ok: train_step {ts['bound']}-bound "
+        f"mfu={ts['mfu']}, decode {dec['bound']}-bound "
+        f"bw_frac={dec['hbm_bw_frac']}, report CLI rendered "
+        f"{n_exec} executables")
+    return {"exec_profile_ok": True,
+            "exec_profile_train_bound": ts["bound"],
+            "exec_profile_decode_bound": dec["bound"],
+            "exec_profile_registered": n_exec}
+
+
 def bench_smoke():
     """2-step CPU-friendly dry run guarding the dispatch path (tier-1,
     `python bench.py --smoke`): asserts the step-time breakdown fields
@@ -1556,17 +1658,20 @@ def bench_smoke():
     mkrow = _smoke_megakernel()
     trow = _smoke_telemetry()
     drow = _smoke_doctor()
+    erow = _smoke_exec_profile(cold)
     out = {
         "metric": "bench_smoke", "ok": True,
         "compile_ms_cold": cold["compile_ms_cold"],
         "compile_ms_warm": warm["compile_ms_cold"],
         "compile_cache_dir": cold["compile_cache_dir"],
         "doctor": cold["doctor"],
+        "exec_profile": cold["exec_profile"],
         **{k: cold[k] for k in required},
         **qrow,
         **mkrow,
         **trow,
         **drow,
+        **erow,
     }
     log(f"  smoke ok: cold compile {cold['compile_ms_cold']:.0f}ms, "
         f"warm {warm['compile_ms_cold']:.0f}ms, "

@@ -52,6 +52,7 @@ inside the same program.
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -69,6 +70,9 @@ from .fleet.strategy import DistributedStrategy
 from .mesh import Mesh, NamedSharding, PartitionSpec, shard_map
 
 __all__ = ["GPipeTrainer", "stack_block_params"]
+
+# observatory component ids, one per trainer instance (ISSUE 15)
+_GPIPE_IDS = itertools.count()
 
 
 def stack_block_params(blocks: Sequence[Layer]) -> Dict[str, jax.Array]:
@@ -284,6 +288,19 @@ class GPipeTrainer:
             for bundle in opt_state}
         self._blocks_ref = list(blocks)
         self._compiled = None
+
+        # executable observatory + HBM ledger (ISSUE 15): the pipeline
+        # tick joins the process exec registry on its first compile
+        # (train_step), and the resident params/opt state are tracked
+        from ..observability import exec_registry as _exec_registry
+        self.telemetry_label = f"g{next(_GPIPE_IDS)}"
+        self._exec_component = f"trainer:{self.telemetry_label}"
+        _exec_registry.track_bytes(
+            self, "params", self.telemetry_label,
+            _exec_registry.tree_bytes(self.params))
+        _exec_registry.track_bytes(
+            self, "opt_state", self.telemetry_label,
+            _exec_registry.tree_bytes(self.opt_state))
 
     # ------------------------------------------------------------------
     def _slice_frozen_buffers(self, idx):
@@ -756,15 +773,34 @@ class GPipeTrainer:
                     self._compiled, self.params, self.opt_state, lr,
                     step_no, micro_in, micro_lab,
                     device=self.mesh.devices.flat[0])
+            from ..observability import exec_registry as _exec_registry
+            if _exec_registry.enabled():
+                # join the executable observatory pre-call (the step
+                # donates params/opt_state; shape structs must be
+                # captured while the buffers are readable)
+                _exec_registry.register(
+                    self._exec_component, "tick", "train_step",
+                    jitfn=self._compiled,
+                    args=(self.params, self.opt_state, lr, step_no,
+                          micro_in, micro_lab),
+                    donate_argnums=(0, 1),
+                    meta={"schedule": self.schedule,
+                          "pp_size": self.pp_size,
+                          "num_microbatches": self.num_micro})
         t0 = time.perf_counter()
         self.params, self.opt_state, loss = self._compiled(
             self.params, self.opt_state, lr, step_no, micro_in, micro_lab)
         dt = (time.perf_counter() - t0) * 1e3
         if first:
             self._timings["compile_ms_cold"] += dt
+            from ..observability import exec_registry as _exec_registry
+            _exec_registry.registry().note_compile(
+                self._exec_component, "tick", dt)
         else:
             self._timings["dispatch_ms"] += dt
             self._timings["steps_timed"] += 1
+            from ..observability import exec_registry as _exec_registry
+            _exec_registry.note_runtime(self._exec_component, "tick", dt)
         self._step_count += 1
         self.optimizer._step_count = self._step_count
         # deterministic preemption point (PADDLE_FAULT_SIGTERM_STEP) —
@@ -810,6 +846,9 @@ class GPipeTrainer:
         s["comm_fraction"] = round(res["comm_ms"] / mean_step, 4) \
             if (res and mean_step > 0) else None
         from ..observability import doctor as _doctor
+        from ..observability import exec_registry as _exec_registry
+        s["exec_profile"] = _exec_registry.profile(self._exec_component)
+        s["hbm"] = _exec_registry.ledger().snapshot()
         s["doctor"] = _doctor.diagnose(s, kind="train")
         return s
 

@@ -26,6 +26,7 @@ or fail loudly (round-1 verdict: silent flags are worse than errors).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -40,10 +41,19 @@ from ..func import functional_call
 from ..nn.layer_base import Layer
 from ..observability import capture as _capture
 from ..observability import doctor as _doctor
+from ..observability import exec_registry as _exec_registry
 from ..observability import flightrec as _flightrec
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from ..observability import watchdog as _watchdog
+
+# telemetry/observatory component ids: one per trainer instance
+_TRAINER_IDS = itertools.count()
+
+# executable-observatory kinds per compiled-key family (ISSUE 15)
+_EXEC_KINDS = {"fused": "train_step", "fused_out": "train_step",
+               "accum": "train_step", "update": "grad_update",
+               "eval": "eval"}
 from . import async_dispatch
 from .async_dispatch import StepResult
 from .fleet.strategy import DistributedStrategy
@@ -532,6 +542,30 @@ class SpmdTrainer:
 
         self._compiled: Dict[str, Any] = {}
 
+        # executable observatory + HBM ledger (ISSUE 15): the trainer's
+        # compiled step(s) join the process exec registry under this
+        # component label (see _timed_call), and the resident training
+        # state — params, optimizer state, buffers, grad-merge buffer —
+        # is tracked in the ledger (host-side shape math; weakref'd so
+        # a torn-down bench candidate releases its accounting with its
+        # HBM)
+        self.telemetry_label = f"s{next(_TRAINER_IDS)}"
+        self._exec_component = f"trainer:{self.telemetry_label}"
+        _exec_registry.track_bytes(
+            self, "params", self.telemetry_label,
+            _exec_registry.tree_bytes(self.params))
+        _exec_registry.track_bytes(
+            self, "opt_state", self.telemetry_label,
+            _exec_registry.tree_bytes(self.opt_state))
+        if self.buffers:
+            _exec_registry.track_bytes(
+                self, "buffers", self.telemetry_label,
+                _exec_registry.tree_bytes(self.buffers))
+        if self._grad_buf is not None:
+            _exec_registry.track_bytes(
+                self, "grad_buffer", self.telemetry_label,
+                _exec_registry.tree_bytes(self._grad_buf))
+
     # ------------------------------------------------------------------
     def _batch_sharding(self, arr):
         dims = [self.dp_axis if (self.dp_size > 1 and arr.ndim > 0 and
@@ -599,8 +633,23 @@ class SpmdTrainer:
         advancing steps_timed (the gradient-merge 'update' executable:
         its cost amortizes over the window, so dispatch_ms/steps_timed
         stays a truthful per-train_step figure)."""
-        if self._comm_enabled and key not in self._first_call_keys:
-            self._analyze_comm(key, args)
+        if key not in self._first_call_keys:
+            if self._comm_enabled:
+                self._analyze_comm(key, args)
+            if _exec_registry.enabled():
+                # join the executable observatory at compile time: the
+                # arg shape structs are captured pre-call (the step may
+                # donate params/opt_state), the XLA cost/memory
+                # analysis stays deferred to exec_registry.analyze
+                fam = key[0] if isinstance(key, tuple) else str(key)
+                _exec_registry.register(
+                    self._exec_component, key,
+                    _EXEC_KINDS.get(fam, str(fam)),
+                    jitfn=self._compiled[key], args=args,
+                    donate_argnums=(0, 1) if fam != "eval" else (),
+                    meta={"mesh_axes": dict(self.mesh.shape),
+                          "zero_stage": self.zero_stage,
+                          "amp": self.amp_enabled})
         t0 = time.perf_counter()
         res = self._compiled[key](*args)
         dt = (time.perf_counter() - t0) * 1e3
@@ -608,9 +657,12 @@ class SpmdTrainer:
             self._timings["dispatch_ms"] += dt
             if count_step:
                 self._timings["steps_timed"] += 1
+            _exec_registry.note_runtime(self._exec_component, key, dt)
         else:
             self._first_call_keys.add(key)
             self._timings["compile_ms_cold"] += dt
+            _exec_registry.registry().note_compile(
+                self._exec_component, key, dt)
         tr = _spans.tracer()
         if tr.active:
             now = tr.now_us()
@@ -1387,6 +1439,12 @@ class SpmdTrainer:
         mean_step = (self._timings["dispatch_ms"] / steps) if steps else 0.0
         s["comm_fraction"] = round(comm_ms / mean_step, 4) \
             if (self._comm and mean_step > 0) else None
+        # executable observatory (ISSUE 15): per-kind roofline digest
+        # for this trainer's executables — populated once the deferred
+        # analyses ran (bench, report CLI, exec_registry.analyze_all).
+        # Reading stats never compiles.
+        s["exec_profile"] = _exec_registry.profile(self._exec_component)
+        s["hbm"] = _exec_registry.ledger().snapshot()
         # perf-doctor verdict over everything above (observability.
         # doctor): ranked [{bottleneck, evidence, knob}] — host-side
         # dict math, the machine-readable half of the ROADMAP-1 triage

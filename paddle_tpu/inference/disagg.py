@@ -86,16 +86,16 @@ class PrefillWorker:
             row = np.zeros(eng.blocks_per_slot, np.int32)
             row[:n] = blocks
             ids = jnp.zeros((1, b), jnp.int32)
-            _, cache = eng._timed(
-                "prefill_ms", ("disagg", b), lambda: self._cold_jit(
-                    eng.params, eng.cache, ids, jnp.asarray(row),
-                    np.int32(1)))
+            _, cache = eng._timed_exec(
+                "prefill_ms", ("disagg", b), self._cold_jit,
+                eng.params, eng.cache, ids, jnp.asarray(row),
+                np.int32(1))
             eng.cache = cache
             if eng._prefix is not None:
-                _, cache = eng._timed(
-                    "prefill_ms", ("disagg_ext", b), lambda: self._ext_jit(
-                        eng.params, eng.cache, ids, jnp.asarray(row),
-                        np.int32(0), np.int32(1)))
+                _, cache = eng._timed_exec(
+                    "prefill_ms", ("disagg_ext", b), self._ext_jit,
+                    eng.params, eng.cache, ids, jnp.asarray(row),
+                    np.int32(0), np.int32(1))
                 eng.cache = cache
             eng._alloc.decref(blocks)
         return self

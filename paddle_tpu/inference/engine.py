@@ -72,6 +72,7 @@ from ..distributed import async_dispatch
 from ..func import functional_apply, functional_state
 from ..observability import capture as _capture
 from ..observability import doctor as _doctor
+from ..observability import exec_registry as _exec_registry
 from ..observability import flightrec as _flightrec
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
@@ -328,6 +329,25 @@ class InferenceEngine:
         # off), and the PADDLE_TPU_PROFILE window keyed on decode ticks.
         self.telemetry_label = f"e{next(InferenceEngine._engine_ids)}"
         lbl = dict(engine=self.telemetry_label)
+        # executable observatory + HBM ledger (ISSUE 15): every compiled
+        # executable this engine builds joins the process registry under
+        # this component label (see _timed_exec), and the resident state
+        # — params, KV pool, draft cache — is tracked in the ledger
+        # (host-side shape math, weakref'd to this engine so a retired
+        # replica's pool drops out of the accounting)
+        self._exec_component = f"engine:{self.telemetry_label}"
+        _exec_registry.track_bytes(
+            self, "params", self.telemetry_label,
+            _exec_registry.tree_bytes(self.params))
+        _exec_registry.track_bytes(
+            self, "kv_cache", self.telemetry_label,
+            _exec_registry.tree_bytes(self.cache),
+            layout=self.kv_layout, kv_dtype=self.kv_dtype or "dense")
+        if self._spec is not None:
+            _exec_registry.track_bytes(
+                self, "spec_draft", self.telemetry_label,
+                _exec_registry.tree_bytes(self._spec.draft_params) +
+                _exec_registry.tree_bytes(self._spec.draft_cache))
         self._tracer = _spans.tracer()
         self._profile = _capture.ProfileWindow.from_env(kind="serve")
         self._m_ticks = _metrics.counter(
@@ -486,6 +506,58 @@ class InferenceEngine:
         return nxt, key, cache
 
     # ---- timing helpers -----------------------------------------------
+    # executable-observatory kind per _timed key family (ISSUE 15): the
+    # registry groups rooflines by these
+    _EXEC_KIND = {"prefill": "prefill", "prefill_paged": "prefill",
+                  "prefill_paged_ext": "prefill", "disagg": "prefill",
+                  "disagg_ext": "prefill", "draft_prefill": "prefill",
+                  "decode": "decode", "spec_tick": "spec_verify",
+                  "sample": "sample"}
+
+    def _register_exec(self, key, jitfn, args):
+        """Join the process exec registry at compile time (the first
+        call of this key): shape structs are captured BEFORE the call
+        runs, so donation never invalidates what analyze() re-lowers
+        from.  Registration is dict writes only — the XLA cost/memory
+        analysis stays deferred until something asks for it."""
+        fam = key[0] if isinstance(key, tuple) else str(key)
+        kind = self._EXEC_KIND.get(fam, str(fam))
+        meta = {"kv_layout": self.kv_layout,
+                "kv_dtype": self.kv_dtype or "dense"}
+        if kind == "decode":
+            from ..ops.decode_megakernel import megakernel_enabled
+            if megakernel_enabled(self.model.cfg):
+                kind = "megakernel_decode"
+                meta["megakernel"] = True
+            meta["batch_slots"] = self.batch_slots
+        elif kind == "spec_verify":
+            meta["spec_k"] = self.spec_k
+        if isinstance(key, tuple) and len(key) > 1 and key[1]:
+            meta["bucket"] = int(key[1])
+        # donation per family, matching the jax.jit construction: the
+        # sampler never donates, the spec tick donates both caches
+        # (spec_decode.py argnums 2+3), everything else donates its
+        # cache operand 1 — the registry's donation evidence must be
+        # what the executable actually does
+        if not self._donate or kind == "sample":
+            donate = ()
+        elif kind == "spec_verify":
+            donate = (2, 3)
+        else:
+            donate = (1,)
+        _exec_registry.register(
+            self._exec_component, key, kind, jitfn=jitfn, args=args,
+            donate_argnums=donate, meta=meta)
+
+    def _timed_exec(self, kind, key, jitfn, *args):
+        """_timed with observatory wiring: the jitted callable and its
+        args are visible here, so the first call registers the
+        executable and steady-state calls pair their wall time with the
+        registry entry (one dict lookup + two adds — zero syncs)."""
+        if key not in self._first_call_keys and _exec_registry.enabled():
+            self._register_exec(key, jitfn, args)
+        return self._timed(kind, key, lambda: jitfn(*args))
+
     def _timed(self, kind, key, fn):
         t0 = time.perf_counter()
         if key not in self._first_call_keys:
@@ -496,11 +568,15 @@ class InferenceEngine:
                     out = fn()
             else:
                 out = fn()
-            self._timings["compile_ms_cold"] += \
-                (time.perf_counter() - t0) * 1e3
+            dt = (time.perf_counter() - t0) * 1e3
+            self._timings["compile_ms_cold"] += dt
+            _exec_registry.registry().note_compile(
+                self._exec_component, key, dt)
         else:
             out = fn()
-            self._timings[kind] += (time.perf_counter() - t0) * 1e3
+            dt = (time.perf_counter() - t0) * 1e3
+            self._timings[kind] += dt
+            _exec_registry.note_runtime(self._exec_component, key, dt)
         return out
 
     # ---- public API ---------------------------------------------------
@@ -673,11 +749,11 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         # np (not list) literals: a python-float list would lower an
         # extra convert_element_type executable on the admission path
-        tok = self._timed(
-            "prefill_ms", ("sample", 1), lambda: self._sample_jit(
-                logits, sub,
-                np.asarray([req.temperature], np.float32),
-                np.asarray([req.top_p], np.float32)))
+        tok = self._timed_exec(
+            "prefill_ms", ("sample", 1), self._sample_jit,
+            logits, sub,
+            np.asarray([req.temperature], np.float32),
+            np.asarray([req.top_p], np.float32))
         tok = int(np.asarray(tok)[0])
         async_dispatch.record_host_sync()
         now = time.perf_counter()
@@ -725,10 +801,10 @@ class InferenceEngine:
         plen = prompt.size
         req.t_admit = time.perf_counter()
         self._timings["prefill_tokens"] += bucket
-        logits, cache = self._timed(
-            "prefill_ms", ("prefill", bucket), lambda: self._prefill_jit(
-                self.params, self.cache, jnp.asarray(ids),
-                np.int32(slot), np.int32(plen)))
+        logits, cache = self._timed_exec(
+            "prefill_ms", ("prefill", bucket), self._prefill_jit,
+            self.params, self.cache, jnp.asarray(ids),
+            np.int32(slot), np.int32(plen))
         self.cache = cache
         self._record_admission(req, slot, plen, logits)
 
@@ -818,18 +894,16 @@ class InferenceEngine:
         row = np.zeros(self.blocks_per_slot, np.int32)
         row[:len(blocks)] = blocks
         if prefix_len == 0:
-            logits, cache = self._timed(
-                "prefill_ms", (key_prefix, bucket),
-                lambda: cold_jit(
-                    self.params, self.cache, jnp.asarray(ids),
-                    jnp.asarray(row), np.int32(suffix.size)))
+            logits, cache = self._timed_exec(
+                "prefill_ms", (key_prefix, bucket), cold_jit,
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(row), np.int32(suffix.size))
         else:
-            logits, cache = self._timed(
-                "prefill_ms", (key_prefix + "_ext", bucket),
-                lambda: ext_jit(
-                    self.params, self.cache, jnp.asarray(ids),
-                    jnp.asarray(row), np.int32(prefix_len),
-                    np.int32(suffix.size)))
+            logits, cache = self._timed_exec(
+                "prefill_ms", (key_prefix + "_ext", bucket), ext_jit,
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(row), np.int32(prefix_len),
+                np.int32(suffix.size))
         self.cache = cache
 
         # trim: blocks past the REAL prompt extent only ever held bucket
@@ -1080,22 +1154,21 @@ class InferenceEngine:
         self._m_active.set(n_active)
         tick_t0 = self._tracer.now_us() if self._tracer.active else 0.0
         if self.kv_layout == "paged":
-            nxt, self._key, cache = self._timed(
-                "decode_ms", ("decode", 0),
-                lambda: self._decode_paged_jit(
-                    self.params, self.cache,
-                    jnp.asarray(self._next_token),
-                    jnp.asarray(self._tables),
-                    jnp.asarray(self._slot_len.astype(np.int32)),
-                    self._key, jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ps)))
+            nxt, self._key, cache = self._timed_exec(
+                "decode_ms", ("decode", 0), self._decode_paged_jit,
+                self.params, self.cache,
+                jnp.asarray(self._next_token),
+                jnp.asarray(self._tables),
+                jnp.asarray(self._slot_len.astype(np.int32)),
+                self._key, jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps))
         else:
-            nxt, self._key, cache = self._timed(
-                "decode_ms", ("decode", 0), lambda: self._decode_jit(
-                    self.params, self.cache,
-                    jnp.asarray(self._next_token),
-                    jnp.asarray(active_np), self._key,
-                    jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+            nxt, self._key, cache = self._timed_exec(
+                "decode_ms", ("decode", 0), self._decode_jit,
+                self.params, self.cache,
+                jnp.asarray(self._next_token),
+                jnp.asarray(active_np), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         self.cache = cache
         # the ONE host sync of the decode step: the scheduler needs the
         # sampled ids for EOS retirement and admission
@@ -1338,21 +1411,20 @@ class InferenceEngine:
     def _warmup_dense(self, buckets):
         for b in (buckets or [self.buckets[0]]):
             ids = jnp.zeros((1, b), jnp.int32)
-            logits, cache = self._timed(
-                "prefill_ms", ("prefill", b), lambda: self._prefill_jit(
-                    self.params, self.cache, ids, np.int32(0),
-                    np.int32(1)))
+            logits, cache = self._timed_exec(
+                "prefill_ms", ("prefill", b), self._prefill_jit,
+                self.params, self.cache, ids, np.int32(0), np.int32(1))
             self.cache = cache
         self._key, sub = jax.random.split(self._key)
-        self._timed("prefill_ms", ("sample", 1), lambda: self._sample_jit(
-            logits, sub, jnp.zeros((1,), jnp.float32),
-            jnp.ones((1,), jnp.float32)))
-        nxt, self._key, cache = self._timed(
-            "decode_ms", ("decode", 0), lambda: self._decode_jit(
-                self.params, self.cache,
-                jnp.zeros(self.batch_slots, jnp.int32),
-                jnp.zeros(self.batch_slots, jnp.int32), self._key,
-                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        self._timed_exec("prefill_ms", ("sample", 1), self._sample_jit,
+                         logits, sub, jnp.zeros((1,), jnp.float32),
+                         jnp.ones((1,), jnp.float32))
+        nxt, self._key, cache = self._timed_exec(
+            "decode_ms", ("decode", 0), self._decode_jit,
+            self.params, self.cache,
+            jnp.zeros(self.batch_slots, jnp.int32),
+            jnp.zeros(self.batch_slots, jnp.int32), self._key,
+            jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         # drop the warmup garbage: zero every slot's length (host-side
         # constant, so no extra executable rides the hot path)
         self.cache = type(cache)(cache.k, cache.v,
@@ -1374,35 +1446,35 @@ class InferenceEngine:
             row = np.zeros(self.blocks_per_slot, np.int32)
             row[:n] = blocks
             ids = jnp.zeros((1, b), jnp.int32)
-            logits, cache = self._timed(
+            logits, cache = self._timed_exec(
                 "prefill_ms", ("prefill_paged", b),
-                lambda: self._prefill_paged_cold_jit(
-                    self.params, self.cache, ids, jnp.asarray(row),
-                    np.int32(1)))
+                self._prefill_paged_cold_jit,
+                self.params, self.cache, ids, jnp.asarray(row),
+                np.int32(1))
             self.cache = cache
             if self._prefix is not None:
-                logits, cache = self._timed(
+                logits, cache = self._timed_exec(
                     "prefill_ms", ("prefill_paged_ext", b),
-                    lambda: self._prefill_paged_ext_jit(
-                        self.params, self.cache, ids, jnp.asarray(row),
-                        np.int32(0), np.int32(1)))
+                    self._prefill_paged_ext_jit,
+                    self.params, self.cache, ids, jnp.asarray(row),
+                    np.int32(0), np.int32(1))
                 self.cache = cache
             self._alloc.decref(blocks)
         if logits is not None:
             self._key, sub = jax.random.split(self._key)
-            self._timed("prefill_ms", ("sample", 1),
-                        lambda: self._sample_jit(
-                            logits, sub, jnp.zeros((1,), jnp.float32),
-                            jnp.ones((1,), jnp.float32)))
+            self._timed_exec("prefill_ms", ("sample", 1),
+                             self._sample_jit, logits, sub,
+                             jnp.zeros((1,), jnp.float32),
+                             jnp.ones((1,), jnp.float32))
         # decode over all-null tables: every write lands in the null
         # block, every slot length is 0 — pure compile fodder
-        nxt, self._key, cache = self._timed(
-            "decode_ms", ("decode", 0), lambda: self._decode_paged_jit(
-                self.params, self.cache,
-                jnp.zeros(self.batch_slots, jnp.int32),
-                jnp.asarray(self._tables),
-                jnp.zeros(self.batch_slots, jnp.int32), self._key,
-                jnp.asarray(self._temps), jnp.asarray(self._top_ps)))
+        nxt, self._key, cache = self._timed_exec(
+            "decode_ms", ("decode", 0), self._decode_paged_jit,
+            self.params, self.cache,
+            jnp.zeros(self.batch_slots, jnp.int32),
+            jnp.asarray(self._tables),
+            jnp.zeros(self.batch_slots, jnp.int32), self._key,
+            jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         self.cache = cache
         return self
 
@@ -1511,6 +1583,13 @@ class InferenceEngine:
             p50, p99 = np.percentile(ttfts, [50, 99])
             s["ttft_ms_p50"] = round(float(p50), 3)
             s["ttft_ms_p99"] = round(float(p99), 3)
+        # executable observatory (ISSUE 15): the per-kind roofline
+        # digest for THIS engine's executables — populated once
+        # something ran the deferred analyses (bench legs, the report
+        # CLI, exec_registry.analyze_all); None until then.  Reading
+        # stats never compiles and never syncs.
+        s["exec_profile"] = _exec_registry.profile(self._exec_component)
+        s["hbm"] = _exec_registry.ledger().snapshot()
         # perf-doctor verdict over the serving signals above
         # (observability.doctor): ranked [{bottleneck, evidence, knob}]
         s["doctor"] = _doctor.diagnose(s, kind="serve")

@@ -229,11 +229,11 @@ class SpecDecoder:
         bucket = eng._bucket_for(prompt.size)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :prompt.size] = prompt
-        _, cache = eng._timed(
+        _, cache = eng._timed_exec(
             "prefill_ms", ("draft_prefill", bucket),
-            lambda: self._draft_prefill_jit(
-                self.draft_params, self.draft_cache, jnp.asarray(ids),
-                np.int32(slot), np.int32(prompt.size)))
+            self._draft_prefill_jit,
+            self.draft_params, self.draft_cache, jnp.asarray(ids),
+            np.int32(slot), np.int32(prompt.size))
         self.draft_cache = cache
         self.win[slot, :] = 0
         self.win[slot, 0] = first_tok
@@ -264,21 +264,19 @@ class SpecDecoder:
         count per slot)."""
         eng = self.engine
         if eng.kv_layout == "paged":
-            out, t_cache, d_cache = eng._timed(
-                "decode_ms", ("spec_tick", 0),
-                lambda: self._tick_paged_jit(
-                    eng.params, self.draft_params, eng.cache,
-                    self.draft_cache, jnp.asarray(self.win),
-                    jnp.asarray(self.nprev), jnp.asarray(active),
-                    jnp.asarray(eng._tables),
-                    jnp.asarray(eng._slot_len.astype(np.int32))))
+            out, t_cache, d_cache = eng._timed_exec(
+                "decode_ms", ("spec_tick", 0), self._tick_paged_jit,
+                eng.params, self.draft_params, eng.cache,
+                self.draft_cache, jnp.asarray(self.win),
+                jnp.asarray(self.nprev), jnp.asarray(active),
+                jnp.asarray(eng._tables),
+                jnp.asarray(eng._slot_len.astype(np.int32)))
         else:
-            out, t_cache, d_cache = eng._timed(
-                "decode_ms", ("spec_tick", 0),
-                lambda: self._tick_dense_jit(
-                    eng.params, self.draft_params, eng.cache,
-                    self.draft_cache, jnp.asarray(self.win),
-                    jnp.asarray(self.nprev), jnp.asarray(active)))
+            out, t_cache, d_cache = eng._timed_exec(
+                "decode_ms", ("spec_tick", 0), self._tick_dense_jit,
+                eng.params, self.draft_params, eng.cache,
+                self.draft_cache, jnp.asarray(self.win),
+                jnp.asarray(self.nprev), jnp.asarray(active))
         eng.cache = t_cache
         self.draft_cache = d_cache
         return out
@@ -305,11 +303,11 @@ class SpecDecoder:
         eng = self.engine
         for b in eng.buckets:
             ids = jnp.zeros((1, b), jnp.int32)
-            _, cache = eng._timed(
+            _, cache = eng._timed_exec(
                 "prefill_ms", ("draft_prefill", b),
-                lambda: self._draft_prefill_jit(
-                    self.draft_params, self.draft_cache, ids,
-                    np.int32(0), np.int32(1)))
+                self._draft_prefill_jit,
+                self.draft_params, self.draft_cache, ids,
+                np.int32(0), np.int32(1))
             self.draft_cache = cache
         active = np.zeros(eng.batch_slots, np.int32)
         self.tick(active)

@@ -33,15 +33,16 @@ host syncs per decode tick and keeps the decode loop zero-recompile;
 telemetry-off adds no per-step allocations.
 """
 from . import doctor
+from . import exec_registry
 from . import flightrec
 from . import metrics
 from . import spans
 from . import watchdog
 from .capture import ProfileWindow, parse_profile_spec
 from .doctor import diagnose
+from .exec_registry import ExecRegistry, HBMLedger
 from .flightrec import FlightRecorder
-from .metrics import (counter, gauge, histogram, parse_exposition,
-                      registry, write_snapshot)
+from .metrics import counter, gauge, histogram, parse_exposition, registry
 from .slo import FleetAggregator, SLOMonitor, load_bench_baseline
 from .spans import (export_chrome_trace, span, tracer,
                     validate_chrome_trace)
@@ -55,15 +56,33 @@ __all__ = [
     "FleetAggregator", "SLOMonitor", "load_bench_baseline",
     "flightrec", "FlightRecorder", "watchdog", "Watchdog",
     "detect_stragglers", "doctor", "diagnose",
+    "exec_registry", "ExecRegistry", "HBMLedger",
 ]
 
 
 def snapshot() -> dict:
     """THE one-call answer: every registered train/serve/fleet metric,
-    JSON-safe, plus tracer state."""
+    the executable observatory (per-executable cost/roofline records —
+    whatever analyses have run; reading never compiles), the HBM
+    ledger, and tracer state — all JSON-safe."""
     return {
         "metrics": metrics.snapshot(),
+        "executables": exec_registry.snapshot(),
+        "hbm": exec_registry.ledger().snapshot(),
         "spans": {"buffered": len(spans.tracer()),
                   "dropped": spans.tracer().dropped,
                   "active": spans.tracer().active},
     }
+
+
+def write_snapshot(path=None, extra=None):
+    """Append one FULL snapshot line (metrics + executables + hbm) to
+    the JSONL history file — same atomic-rename + line/size rotation as
+    metrics.write_snapshot, which this wraps.  The report CLI
+    (``python -m paddle_tpu.observability.report``) renders these files
+    offline."""
+    full = {"executables": exec_registry.snapshot(),
+            "hbm": exec_registry.ledger().snapshot()}
+    if extra:
+        full.update(extra)
+    return metrics.write_snapshot(path, extra=full)

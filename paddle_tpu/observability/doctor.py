@@ -27,6 +27,7 @@ loop must read host-sync-bound; a clean one must read clean).
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["diagnose", "RULES", "Rule"]
@@ -46,6 +47,12 @@ SPEC_ACCEPTANCE_MIN = 0.3
 PREFIX_HIT_RATE_MIN = 0.15
 PREFIX_QUERIES_MIN = 20
 SLOT_OCCUPANCY_MIN = 0.5
+# roofline/ledger rules (exec registry evidence, ISSUE 15)
+HBM_BW_FRAC_MIN = 0.5      # decode pushing >= half the HBM roof
+from .exec_registry import MFU_TARGET as MFU_GAP_MIN          # noqa: E402
+from .exec_registry import OOM_HEADROOM_MIN as HBM_HEADROOM_MIN  # noqa: E402
+# (one source of truth: the registry's attribution target and the
+# ledger's oom_risk line — the doctor must agree with both surfaces)
 
 
 def _num(stats: dict, key: str) -> Optional[float]:
@@ -193,21 +200,105 @@ def _idle_slots(s: dict):
             "decode_steps": int(steps)}, 0.5 * (1.0 - occ)
 
 
+def _exec_prof(s: dict, *kinds) -> Optional[dict]:
+    """The exec-registry roofline digest riding stats['exec_profile']
+    (observability.exec_registry.profile): first matching kind's row,
+    or None.  Nominal-peak digests (host backends) are ignored unless
+    PADDLE_TPU_ROOFLINE_DOCTOR=1 forces them — a laptop smoke must not
+    read as a TPU roofline verdict."""
+    prof = s.get("exec_profile")
+    if not isinstance(prof, dict):
+        return None
+    peaks = prof.get("_peaks") or {}
+    if peaks.get("peaks_nominal") and \
+            os.environ.get("PADDLE_TPU_ROOFLINE_DOCTOR") != "1":
+        return None
+    for k in kinds:
+        row = prof.get(k)
+        if isinstance(row, dict):
+            return row
+    return None
+
+
 def _hbm_heavy_decode(s: dict):
-    # advisory: a full-precision, non-fused decode loop streams bytes
-    # the int8 cache + megakernel paths exist to cut — only worth
-    # saying when decode work actually happened
-    hbm = _num(s, "decode_hbm_bytes_per_tok")
+    """Roofline-aware decode verdict: with the exec registry analyzed,
+    the evidence is the MEASURED bandwidth fraction ("decode achieves
+    72% of peak HBM BW → bandwidth-bound"); without it, fall back to
+    the old threshold heuristic (bytes/token with no byte-saver on)."""
     steps = _num(s, "decode_steps")
-    if hbm is None or steps is None or steps < 8:
+    if steps is None or steps < 8:
         return None
     kv = s.get("kv_dtype")
     mk = s.get("decode_megakernel")
-    if kv not in (None, "dense") or mk:
-        return None                    # a byte-saver is already on
+    saver_on = kv not in (None, "dense") or bool(mk)
+    row = _exec_prof(s, "decode", "megakernel_decode", "spec_verify")
+    if row is not None and row.get("bound"):
+        # measured roofline evidence is AUTHORITATIVE: a compute-bound
+        # or below-the-floor decode must not fall through to the byte
+        # heuristic and contradict the measurement
+        if row["bound"] != "bandwidth" or \
+                row.get("hbm_bw_frac") is None or \
+                float(row["hbm_bw_frac"]) < HBM_BW_FRAC_MIN:
+            return None
+        frac = float(row["hbm_bw_frac"])
+        ev = {"hbm_bw_frac": round(frac, 4),
+              "achieved_hbm_gbps": row.get("achieved_hbm_gbps"),
+              "arithmetic_intensity": row.get("arithmetic_intensity"),
+              "ridge_ai": row.get("ridge_ai"),
+              "bound": "bandwidth",
+              "kv_dtype": kv or "dense",
+              "decode_megakernel": bool(mk)}
+        if row.get("mfu") is not None:
+            ev["mfu"] = row["mfu"]
+        # a byte-saver already on shrinks the verdict to informational
+        return ev, (min(frac, 1.0) if not saver_on else 0.15)
+    # threshold fallback (pre-registry evidence only)
+    hbm = _num(s, "decode_hbm_bytes_per_tok")
+    if hbm is None or saver_on:
+        return None
     return {"decode_hbm_bytes_per_tok": int(hbm),
             "kv_dtype": kv or "dense",
             "decode_megakernel": bool(mk)}, 0.3
+
+
+def _roofline_train(s: dict):
+    """Train-step roofline attribution: the fused step's measured MFU
+    against the 45% target, classified compute- vs bandwidth-bound so
+    the knob is the right one (quantize/flash for compute, remat/batch
+    for bandwidth)."""
+    row = _exec_prof(s, "train_step", "pipeline_tick")
+    if row is None or row.get("mfu") is None or not row.get("bound"):
+        return None
+    mfu = float(row["mfu"])
+    if mfu >= MFU_GAP_MIN:
+        return None                     # at/near target: nothing to say
+    ev = {"mfu": round(mfu, 4), "bound": row["bound"],
+          "arithmetic_intensity": row.get("arithmetic_intensity"),
+          "ridge_ai": row.get("ridge_ai"),
+          "mean_ms": row.get("mean_ms")}
+    if row.get("hbm_bw_frac") is not None:
+        ev["hbm_bw_frac"] = row["hbm_bw_frac"]
+    if row.get("gap_share") is not None:
+        ev["gap_share"] = row["gap_share"]
+    return ev, min(1.0, (MFU_GAP_MIN - mfu) / MFU_GAP_MIN)
+
+
+def _oom_risk(s: dict):
+    """HBM-ledger headroom: tracked state + worst executable temp
+    against device capacity.  Fires before the OOM does."""
+    h = s.get("hbm")
+    if not isinstance(h, dict):
+        return None
+    frac = h.get("headroom_frac")
+    if not isinstance(frac, (int, float)) or frac >= HBM_HEADROOM_MIN:
+        return None
+    ev = {"headroom_frac": round(float(frac), 4),
+          "tracked_bytes": h.get("tracked_bytes"),
+          "capacity_bytes": h.get("capacity_bytes"),
+          "exec_temp_bytes": h.get("exec_temp_bytes")}
+    if h.get("exec_temp_worst"):
+        ev["exec_temp_worst"] = h["exec_temp_worst"]
+    return ev, min(1.0, 1.0 - float(frac))
 
 
 RULES: List[Rule] = [
@@ -249,10 +340,22 @@ RULES: List[Rule] = [
          "raise batch_slots (PADDLE_TPU_DECODE_SLOTS) / check arrival "
          "rate vs capacity",
          _idle_slots),
-    Rule("hbm-heavy-decode", ("serve",),
+    Rule("bandwidth-bound-decode", ("serve",),
          "enable the decode megakernel (PADDLE_TPU_DECODE_MEGAKERNEL=1)"
-         " / int8 KV (PADDLE_TPU_KV_DTYPE=int8)",
+         " / int8 KV (PADDLE_TPU_KV_DTYPE=int8) / speculative decoding "
+         "(PADDLE_TPU_SPEC_K) to amortize the streamed bytes",
          _hbm_heavy_decode),
+    Rule("mfu-below-target", ("train",),
+         "compute-bound: quantize=int8 (BENCH_QUANTIZE) / flash "
+         "attention / remat off; bandwidth-bound: larger batch / "
+         "fused_ce / scan_layers — see exec_profile gap_share for the "
+         "executable owning the gap",
+         _roofline_train),
+    Rule("oom-risk", ("train", "serve"),
+         "int8 KV (PADDLE_TPU_KV_DTYPE=int8) / fewer decode slots "
+         "(PADDLE_TPU_DECODE_SLOTS) or KV blocks (PADDLE_TPU_KV_BLOCKS)"
+         " / smaller batch / remat on (strategy.recompute)",
+         _oom_risk),
 ]
 
 

@@ -57,7 +57,7 @@ from . import metrics as _metrics
 from . import spans as _spans
 
 __all__ = ["FlightRecorder", "recorder", "record", "note_event", "dump",
-           "install", "enabled", "dump_dir", "load_bundle",
+           "install", "enabled", "dump_dir", "load_bundle", "gc_bundles",
            "PID_FLIGHTREC"]
 
 # chrome-trace process id for ring-synthesized spans (1=host, 2=requests)
@@ -160,6 +160,15 @@ class FlightRecorder:
             "stacks": all_thread_stacks(),
             "metrics": _metrics.snapshot(),
         }
+        try:
+            # the executable observatory rides every post-mortem: which
+            # executables existed, their timings and (if analyzed)
+            # roofline positions — pure dict reads, no compiles here
+            from . import exec_registry as _er
+            doc["executables"] = _er.snapshot()
+            doc["hbm"] = _er.ledger().snapshot()
+        except Exception:
+            pass
         if extra:
             doc.update(extra)
         return doc
@@ -223,6 +232,7 @@ class FlightRecorder:
             os.rename(tmp, final)
             self.last_dump_path = final
             self._m_dumps.labels(reason=reason).inc()
+            gc_bundles(base)
             print(f"flightrec: wrote post-mortem bundle {final} "
                   f"(reason={reason})", file=sys.stderr, flush=True)
             return final
@@ -311,6 +321,54 @@ def load_bundle(path: str) -> dict:
     if bundle.get("format") != "paddle_tpu.flightrec.v1":
         raise ValueError(f"{path}: not a flightrec bundle")
     return {"bundle": bundle, "trace": trace}
+
+
+_KEEP_DEFAULT = 32
+_TMP_ORPHAN_AGE_S = 3600.0
+
+
+def gc_bundles(directory: Optional[str] = None):
+    """Bundle-dir GC, run at every dump: the per-process dump cap
+    bounds ONE process, but a long-lived multi-replica fleet restarts
+    processes for weeks and each leaves its 16 — prune the OLDEST
+    committed bundle dirs beyond ``PADDLE_TPU_FLIGHTREC_KEEP`` (default
+    32, by mtime so multi-process interleavings order correctly), and
+    sweep ``.tmp`` staging orphans older than an hour (a crash mid-dump
+    in a dead process; a live process's in-flight .tmp is younger and
+    untouched).  Never raises — GC must not mask the failure being
+    recorded."""
+    import shutil
+    base = directory or dump_dir()
+    try:
+        keep = int(os.environ.get("PADDLE_TPU_FLIGHTREC_KEEP",
+                                  _KEEP_DEFAULT))
+    except ValueError:
+        keep = _KEEP_DEFAULT
+    keep = max(keep, 1)
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return
+    now = time.time()
+    committed = []
+    for n in names:
+        if not n.startswith("flightrec-"):
+            continue
+        p = os.path.join(base, n)
+        if n.endswith(".tmp"):
+            try:
+                if now - os.path.getmtime(p) > _TMP_ORPHAN_AGE_S:
+                    shutil.rmtree(p, ignore_errors=True)
+            except OSError:
+                pass
+            continue
+        try:
+            committed.append((os.path.getmtime(p), p))
+        except OSError:
+            pass
+    committed.sort()
+    for _, p in committed[:max(len(committed) - keep, 0)]:
+        shutil.rmtree(p, ignore_errors=True)
 
 
 def find_bundles(directory: Optional[str] = None,

@@ -350,6 +350,22 @@ class Registry:
                 pass
             if keep >= 0 and len(prior) > keep:
                 prior = prior[-keep:] if keep else []
+            # size-based rotation on top of the line bound
+            # (PADDLE_TPU_METRICS_SNAPSHOT_MAX_MB, default 64): a
+            # week-long serve run snapshotting fat label sets must not
+            # grow the file unbounded — drop oldest lines until the
+            # rewrite fits; the NEW line always lands even if it alone
+            # exceeds the budget (current state beats history)
+            try:
+                max_mb = float(os.environ.get(
+                    "PADDLE_TPU_METRICS_SNAPSHOT_MAX_MB", 64))
+            except ValueError:
+                max_mb = 64.0
+            if max_mb > 0:
+                budget = max_mb * 1e6 - len(line)
+                total = sum(len(p) for p in prior)
+                while prior and total > budget:
+                    total -= len(prior.pop(0))
             from ..framework.fs import open_for_write
             with open_for_write(path, "w") as f:
                 f.write("".join(prior) + line)

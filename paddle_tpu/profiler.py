@@ -154,28 +154,59 @@ class StepTimer:
                 "p50_ms": self.p50_ms, "last_ms": self.last_ms}
 
 
+def _analysis_degraded(stage: str, exc=None) -> dict:
+    """An executable whose XLA analysis is unavailable (jaxlib CPU
+    deserialized executables return None or raise) degrades to {} —
+    the exec registry keeps the entry timing-only — and the failure is
+    counted so a fleet dashboard can see the blind spot."""
+    try:
+        from .observability import metrics as _metrics
+        _metrics.counter(
+            "exec_analysis_failures_total",
+            "executable cost/memory analyses that degraded to "
+            "timing-only", labels=("stage",)).labels(stage=stage).inc()
+    except Exception:
+        pass
+    return {}
+
+
 def memory_stats(compiled) -> dict:
     """Peak-memory evidence for a compiled executable (reference
     monitor.h STAT_ADD GPU-mem stats). Works on jax.jit(...).lower(...)
-    .compile() results and SpmdTrainer.step_executable."""
-    ma = compiled.memory_analysis()
+    .compile() results and SpmdTrainer.step_executable.  Backends where
+    ``memory_analysis()`` returns None or raises (jaxlib CPU
+    deserialized executables) yield {} instead of throwing, with an
+    ``exec_analysis_failures_total`` count."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:
+        return _analysis_degraded("memory_analysis", e)
     if ma is None:
-        return {}
-    return {
-        "argument_bytes": ma.argument_size_in_bytes,
-        "output_bytes": ma.output_size_in_bytes,
-        "temp_bytes": ma.temp_size_in_bytes,
-        "alias_bytes": ma.alias_size_in_bytes,
-        "peak_bytes": ma.argument_size_in_bytes +
-        ma.output_size_in_bytes + ma.temp_size_in_bytes -
-        ma.alias_size_in_bytes,
-    }
+        return _analysis_degraded("memory_analysis")
+    try:
+        return {
+            "argument_bytes": ma.argument_size_in_bytes,
+            "output_bytes": ma.output_size_in_bytes,
+            "temp_bytes": ma.temp_size_in_bytes,
+            "alias_bytes": ma.alias_size_in_bytes,
+            "peak_bytes": ma.argument_size_in_bytes +
+            ma.output_size_in_bytes + ma.temp_size_in_bytes -
+            ma.alias_size_in_bytes,
+        }
+    except Exception as e:
+        return _analysis_degraded("memory_analysis", e)
 
 
 def cost_stats(compiled) -> dict:
-    """FLOP/byte estimates from XLA's cost analysis."""
-    ca = compiled.cost_analysis()
+    """FLOP/byte estimates from XLA's cost analysis.  Same degradation
+    contract as memory_stats: None / raising backends yield {}."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:
+        return _analysis_degraded("cost_analysis", e)
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
+    if not isinstance(ca, dict) or ca is None:
+        return _analysis_degraded("cost_analysis")
     return {"flops": ca.get("flops", 0.0),
             "bytes_accessed": ca.get("bytes accessed", 0.0)}

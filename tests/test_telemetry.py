@@ -399,12 +399,30 @@ def test_telemetry_on_adds_zero_syncs_and_zero_recompiles(layout, tracer):
     eng.warmup(buckets=[16])
     rng = np.random.RandomState(0)
     prompt = rng.randint(1, 97, (7,)).astype(np.int32)
+    # the executable observatory is ARMED (entries registered at
+    # warmup) — the sync/recompile budget below therefore proves the
+    # registry + HBM ledger add nothing to the hot path (ISSUE 15)
+    from paddle_tpu.observability import exec_registry as er
+    kinds = {e.kind for e in er.registry().entries(eng._exec_component)}
+    assert {"prefill", "decode", "sample"} <= kinds
     with compile_counter.assert_no_recompiles(
             f"{layout} decode with telemetry on"):
         syncs, ticks = _decode_n(eng, prompt, 8)
     # 1 admission sample + 1 per decode tick — nothing else
     assert syncs == ticks + 1, \
         f"telemetry added host syncs: {syncs} for {ticks} ticks"
+    # runtime pairing happened (registry saw every tick) without a
+    # single extra sync or compile
+    dec = [e for e in er.registry().entries(eng._exec_component)
+           if e.kind == "decode"][0]
+    assert dec.calls >= ticks
+    # reading the ledger + stats (exec_profile/hbm/doctor) is dict math
+    s0 = async_dispatch.host_sync_count()
+    with compile_counter.assert_no_recompiles("stats read"):
+        st = eng.stats
+        er.ledger().snapshot()
+    assert async_dispatch.host_sync_count() == s0
+    assert "exec_profile" in st and "hbm" in st
     # the request left a full lifecycle on its track
     from paddle_tpu.observability.spans import PID_REQUESTS
     req_spans = {e["name"] for e in tracer.chrome_trace()["traceEvents"]
@@ -430,6 +448,70 @@ def test_telemetry_on_spec_decode_zero_recompiles(tracer):
                   if e["name"] == "spec_tick"]
     assert spec_ticks and all("committed" in e["args"]
                               for e in spec_ticks)
+    # the spec tick joined the observatory as its own kind (ISSUE 15)
+    from paddle_tpu.observability import exec_registry as er
+    kinds = {e.kind for e in er.registry().entries(eng._exec_component)}
+    assert "spec_verify" in kinds
+
+
+def test_exec_registry_armed_trainer_step_budget():
+    """SpmdTrainer half of the ISSUE-15 overhead contract: with the
+    registry + ledger armed (always), a warmed trainer's steps stay
+    recompile-free and the lazy loop performs zero per-step syncs —
+    registration/pairing is pure host dict work."""
+    from paddle_tpu.distributed import SpmdTrainer, create_mesh
+    from paddle_tpu.observability import exec_registry as er
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 10))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    tr = SpmdTrainer(model, opt, lambda o, y: F.cross_entropy(o, y),
+                     mesh=create_mesh({"dp": 1}))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 10, size=(8,)).astype(np.int64)
+    tr.train_step(x, y)                        # warmup/compile
+    assert [e.kind for e in er.registry().entries(tr._exec_component)] \
+        == ["train_step"]
+    s0 = async_dispatch.host_sync_count()
+    with compile_counter.assert_no_recompiles("registry-armed steps"):
+        for _ in range(4):
+            tr.train_step(x, y)                # lazy: no readbacks
+    assert async_dispatch.host_sync_count() == s0
+    e = er.registry().entries(tr._exec_component)[0]
+    assert e.calls >= 4
+    # ledger tracked the trainer state without touching the device
+    cats = {t["category"] for t in er.ledger().snapshot()["tracked"]
+            if t["name"] == tr.telemetry_label}
+    assert "params" in cats
+    assert async_dispatch.host_sync_count() == s0
+
+
+def test_exec_registry_snapshot_to_report_round_trip(tmp_path):
+    """Registry round-trip through observability.snapshot() → the
+    report CLI renderer: what a warmed engine registered must come back
+    out of the offline snapshot file."""
+    from paddle_tpu.observability import exec_registry as er
+    from paddle_tpu.observability import report
+    eng = InferenceEngine(tiny_model(), batch_slots=2,
+                          prefill_buckets=[16])
+    eng.warmup(buckets=[16])
+    rng = np.random.RandomState(5)
+    rid = eng.add_request(rng.randint(1, 97, (5,)).astype(np.int32),
+                          max_new_tokens=4)
+    eng.run()
+    er.analyze_all(eng._exec_component)
+    snap = obs.snapshot()
+    mine = [r for r in snap["executables"]["executables"]
+            if r["component"] == eng._exec_component]
+    assert {"prefill", "decode", "sample"} <= {r["kind"] for r in mine}
+    path = str(tmp_path / "snap.jsonl")
+    obs.write_snapshot(path)
+    rec = report.load_snapshot_file(path)
+    text = report.render_snapshot(rec)
+    assert eng._exec_component in text and "hbm ledger" in text
+    assert report.main(["--snapshot", path]) == 0
 
 
 def test_telemetry_off_buffers_nothing():
