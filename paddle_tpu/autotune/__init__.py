@@ -1,0 +1,42 @@
+"""Doctor-driven autotune: close the perf loop from verdict to knob to
+tuning table (ISSUE 16, ROADMAP item 1).
+
+The observability tier (PRs 13-15) ends every run with a ranked doctor
+verdict and a per-executable MFU gap-attribution — this package ACTS on
+them.  Three tiers behind one env knob, ``PADDLE_TPU_AUTOTUNE``:
+
+- ``off`` (default) — nothing armed; sweeps and humans turn knobs.
+- ``once`` — an offline greedy coordinate-descent pass
+  (:class:`~paddle_tpu.autotune.controller.AutotuneController`, driven
+  by ``bench.py --autotune``): measure the incumbent, follow the
+  doctor's top verdict to exactly ONE knob axis, trial its candidates,
+  accept only a measured improvement beyond the noise floor, commit the
+  winner into the unified tuning table with provenance, re-diagnose,
+  repeat — O(knobs-that-matter) measurements instead of |grid|.
+- ``live`` — the controller's safety-railed sibling inside a serving
+  engine (:class:`~paddle_tpu.autotune.live.LiveRetuner`): an
+  SLO-regression signal schedules exactly one retune episode, the
+  episode waits for a quiesced replica (no active slots, empty queue),
+  re-measures table-only knobs between decode-step windows on already
+  warmed executables (zero recompiles), and hot-applies the winner.
+
+Every trial runs under the flight recorder; a trial that regresses,
+recompile-storms, or trips the watchdog is rolled back to the incumbent
+config and dumped as a ``autotune-rollback`` bundle.
+"""
+from __future__ import annotations
+
+import os
+
+from .knobs import AXES, KnobAxis, axis_for, axis_for_action  # noqa: F401
+from .controller import AutotuneController  # noqa: F401
+
+__all__ = ["AutotuneController", "AXES", "KnobAxis", "axis_for",
+           "axis_for_action", "autotune_mode"]
+
+
+def autotune_mode() -> str:
+    """The PADDLE_TPU_AUTOTUNE tier: 'off' | 'once' | 'live' (anything
+    unrecognized reads as 'off' — a typo must not arm a retuner)."""
+    v = os.environ.get("PADDLE_TPU_AUTOTUNE", "").strip().lower()
+    return v if v in ("once", "live") else "off"

@@ -385,6 +385,11 @@ class InferenceEngine:
         _flightrec.install()
         self.watchdog: Optional[_watchdog.Watchdog] = None
         self._wd_checked = False
+        # live autotune tier (PADDLE_TPU_AUTOTUNE=live): SLO-triggered,
+        # quiesce-gated prefill-bucket retuner — None when unarmed, and
+        # the tick hook below is a single attribute check
+        from ..autotune.live import arm_engine as _arm_autotune
+        self._retuner = _arm_autotune(self)
 
     # ---- paged layout setup -------------------------------------------
     def _init_paged(self, cache_dtype, kv_block_size, kv_num_blocks,
@@ -1110,6 +1115,10 @@ class InferenceEngine:
         this step (admission prefills included)."""
         produced = 0
         self._watchdog_beat()
+        if self._retuner is not None:
+            # runs a PENDING retune episode only on a quiesced replica
+            # (no active slots, empty queue); O(1) otherwise
+            self._retuner.on_tick()
         tick_wall0 = time.perf_counter()
         if self._profile is not None:
             # PADDLE_TPU_PROFILE=start:stop over DECODE TICKS

@@ -63,14 +63,41 @@ def _num(stats: dict, key: str) -> Optional[float]:
 
 class Rule:
     """One named check: ``check(stats)`` returns (evidence, score) when
-    it fires, None when the signal is absent or healthy."""
+    it fires, None when the signal is absent or healthy.
+
+    ``action`` is the MACHINE-readable form of ``knob`` (ISSUE 16): a
+    dict ``{"op", "param", "env", "candidates"}`` — or a callable
+    ``(stats, evidence) -> dict`` when the advice depends on the
+    evidence (e.g. spec_k candidates below the CURRENT k).  ``op`` is
+    the tuning-table namespace a winner commits under (None for advice
+    with no table entry), ``param`` the config axis an autotune
+    controller mutates (None for purely behavioral advice), ``env`` the
+    equivalent environment knob, ``candidates`` the suggested trial
+    values ([] defers to the controller's own axis defaults)."""
 
     def __init__(self, bottleneck: str, kinds: tuple, knob: str,
-                 check: Callable[[dict], Optional[tuple]]):
+                 check: Callable[[dict], Optional[tuple]],
+                 action=None):
         self.bottleneck = bottleneck
         self.kinds = kinds
         self.knob = knob
         self.check = check
+        self.action = action
+
+    def action_for(self, stats: dict, evidence: dict) -> Optional[dict]:
+        """Resolve the structured action for one firing (JSON-safe copy;
+        None when the rule has no machine-actionable form)."""
+        a = self.action
+        if callable(a):
+            try:
+                a = a(stats, evidence)
+            except Exception:
+                return None
+        if not isinstance(a, dict):
+            return None
+        return {"op": a.get("op"), "param": a.get("param"),
+                "env": a.get("env"),
+                "candidates": list(a.get("candidates") or [])}
 
 
 # ---------------------------------------------------------------------------
@@ -301,61 +328,137 @@ def _oom_risk(s: dict):
     return ev, min(1.0, 1.0 - float(frac))
 
 
+# ---------------------------------------------------------------------------
+# evidence-dependent actions (callables: (stats, evidence) -> action dict)
+# ---------------------------------------------------------------------------
+def _spec_k_action(s: dict, ev: dict) -> dict:
+    """Candidates are spec_k values BELOW the current window — a low
+    acceptance rate never argues for drafting further ahead."""
+    cur = s.get("spec_k")
+    cands: list = []
+    if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+        k = int(cur)
+        while k > 1:
+            k //= 2
+            cands.append(max(k, 1))
+            if cands[-1] == 1:
+                break
+    return {"op": None, "param": "spec_k", "env": "PADDLE_TPU_SPEC_K",
+            "candidates": cands or [1, 2]}
+
+
+def _decode_bw_action(s: dict, ev: dict) -> dict:
+    """First byte-saver not already on: megakernel, then int8 KV, then
+    speculative decoding to amortize the streamed bytes."""
+    if not s.get("decode_megakernel"):
+        return {"op": "megakernel_blocks", "param": "decode_megakernel",
+                "env": "PADDLE_TPU_DECODE_MEGAKERNEL",
+                "candidates": [True]}
+    if s.get("kv_dtype") in (None, "dense"):
+        return {"op": None, "param": "kv_dtype",
+                "env": "PADDLE_TPU_KV_DTYPE", "candidates": ["int8"]}
+    return {"op": None, "param": "spec_k", "env": "PADDLE_TPU_SPEC_K",
+            "candidates": [2, 4]}
+
+
+def _mfu_action(s: dict, ev: dict) -> dict:
+    """Compute-bound gap → cheaper math (quantize); bandwidth-bound →
+    recompute less (remat policy A/B) so the bytes drop."""
+    if ev.get("bound") == "compute":
+        return {"op": "qmm_tiles", "param": "quantize",
+                "env": "BENCH_QUANTIZE", "candidates": ["int8"]}
+    return {"op": "remat_policy", "param": "remat_policy", "env": None,
+            "candidates": ["off", "dots_no_batch", "dots", "full"]}
+
+
+def _oom_action(s: dict, ev: dict) -> dict:
+    """Serving evidence (kv_dtype/decode slots present) → shrink the KV;
+    training → turn remat up."""
+    if "kv_dtype" in s or "decode_steps" in s or "block_occupancy" in s:
+        return {"op": None, "param": "kv_dtype",
+                "env": "PADDLE_TPU_KV_DTYPE", "candidates": ["int8"]}
+    return {"op": "remat_policy", "param": "remat_policy", "env": None,
+            "candidates": ["full", "dots"]}
+
+
 RULES: List[Rule] = [
     Rule("comm-bound", ("train",),
          "PADDLE_TPU_OVERLAP=1 / MoELayer a2a_chunks "
          "(PADDLE_TPU_MOE_A2A_CHUNKS) / revisit sharding stage",
-         _comm_bound),
+         _comm_bound,
+         action={"op": "moe_a2a_chunks", "param": "moe_a2a_chunks",
+                 "env": "PADDLE_TPU_MOE_A2A_CHUNKS",
+                 "candidates": [1, 2, 4, 8]}),
     Rule("data-starved", ("train",),
          "raise prefetch_depth (PADDLE_TPU_PREFETCH_DEPTH) / add "
          "DataLoader workers / check input storage",
-         _data_starved),
+         _data_starved,
+         action={"op": None, "param": "prefetch_depth",
+                 "env": "PADDLE_TPU_PREFETCH_DEPTH",
+                 "candidates": [2, 4, 8]}),
     Rule("h2d-bound", ("train",),
          "keep DevicePrefetcher on (PADDLE_TPU_PREFETCH_DEPTH>0) / "
          "shrink host-side batch copies",
-         _h2d_bound),
+         _h2d_bound,
+         action={"op": None, "param": "prefetch_depth",
+                 "env": "PADDLE_TPU_PREFETCH_DEPTH",
+                 "candidates": [2, 4]}),
     Rule("host-sync-bound", ("train", "serve"),
          "keep StepResult lazy (no per-step float(loss)/np.asarray); "
          "read stats at log boundaries; anomaly_policy=rollback costs "
          "1 sync/step",
-         _host_sync_bound),
+         _host_sync_bound,
+         # behavioral: no config axis turns this — the fix is in the
+         # caller's code, so the controller must skip it
+         action={"op": None, "param": None, "env": None,
+                 "candidates": []}),
     Rule("recompile-churn", ("train", "serve"),
          "pin shapes: prefill buckets (PADDLE_TPU_PREFILL_BUCKETS), "
          "fixed batch/seq, persistent compile cache "
          "(PADDLE_TPU_COMPILE_CACHE)",
-         _recompile_churn),
+         _recompile_churn,
+         action={"op": "prefill_buckets", "param": "prefill_buckets",
+                 "env": "PADDLE_TPU_PREFILL_BUCKETS",
+                 "candidates": []}),
     Rule("kv-pressure", ("serve",),
          "raise PADDLE_TPU_KV_BLOCKS / int8 KV "
          "(PADDLE_TPU_KV_DTYPE=int8) / lower max_new_tokens",
-         _kv_pressure),
+         _kv_pressure,
+         action={"op": None, "param": "kv_dtype",
+                 "env": "PADDLE_TPU_KV_DTYPE", "candidates": ["int8"]}),
     Rule("low-spec-acceptance", ("serve",),
          "lower spec_k (PADDLE_TPU_SPEC_K) / use a better-matched "
          "draft model",
-         _low_spec_acceptance),
+         _low_spec_acceptance, action=_spec_k_action),
     Rule("prefix-cold", ("serve",),
          "enable the radix prefix cache (PADDLE_TPU_PREFIX_CACHE=1) / "
          "prefix-aware routing (Router policy='prefix')",
-         _prefix_cold),
+         _prefix_cold,
+         action={"op": None, "param": "prefix_cache",
+                 "env": "PADDLE_TPU_PREFIX_CACHE",
+                 "candidates": [True]}),
     Rule("admission-bound", ("serve",),
          "raise batch_slots (PADDLE_TPU_DECODE_SLOTS) / check arrival "
          "rate vs capacity",
-         _idle_slots),
+         _idle_slots,
+         action={"op": None, "param": "batch_slots",
+                 "env": "PADDLE_TPU_DECODE_SLOTS", "candidates": []}),
     Rule("bandwidth-bound-decode", ("serve",),
          "enable the decode megakernel (PADDLE_TPU_DECODE_MEGAKERNEL=1)"
          " / int8 KV (PADDLE_TPU_KV_DTYPE=int8) / speculative decoding "
          "(PADDLE_TPU_SPEC_K) to amortize the streamed bytes",
-         _hbm_heavy_decode),
+         _hbm_heavy_decode, action=_decode_bw_action),
     Rule("mfu-below-target", ("train",),
          "compute-bound: quantize=int8 (BENCH_QUANTIZE) / flash "
          "attention / remat off; bandwidth-bound: larger batch / "
          "fused_ce / scan_layers — see exec_profile gap_share for the "
          "executable owning the gap",
-         _roofline_train),
+         _roofline_train, action=_mfu_action),
     Rule("oom-risk", ("train", "serve"),
          "int8 KV (PADDLE_TPU_KV_DTYPE=int8) / fewer decode slots "
          "(PADDLE_TPU_DECODE_SLOTS) or KV blocks (PADDLE_TPU_KV_BLOCKS)"
          " / smaller batch / remat on (strategy.recompute)",
-         _oom_risk),
+         _oom_risk, action=_oom_action),
 ]
 
 
@@ -376,9 +479,13 @@ def diagnose(stats: dict, kind: Optional[str] = None) -> List[dict]:
         if hit is None:
             continue
         evidence, score = hit
-        out.append({"bottleneck": rule.bottleneck,
-                    "evidence": evidence,
-                    "knob": rule.knob,
-                    "score": round(float(score), 4)})
+        verdict = {"bottleneck": rule.bottleneck,
+                   "evidence": evidence,
+                   "knob": rule.knob,
+                   "score": round(float(score), 4)}
+        action = rule.action_for(stats, evidence)
+        if action is not None:
+            verdict["action"] = action
+        out.append(verdict)
     out.sort(key=lambda v: -v["score"])
     return out

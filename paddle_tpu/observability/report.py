@@ -25,7 +25,8 @@ import sys
 from typing import List, Optional
 
 __all__ = ["render_executables", "render_hbm", "render_doctor",
-           "render_snapshot", "load_snapshot_file", "main"]
+           "render_tuning", "render_snapshot", "load_snapshot_file",
+           "main"]
 
 
 def _fmt_bytes(n) -> str:
@@ -131,6 +132,21 @@ def render_hbm(h: Optional[dict]) -> str:
     return f"hbm ledger\n{table}\n{tail}"
 
 
+def _fmt_action(a) -> str:
+    """Compact one-cell form of a verdict's structured action:
+    ``param in [candidates]`` plus the table op / env when set; '-' for
+    behavioral advice (no machine-turnable axis)."""
+    if not isinstance(a, dict) or not a.get("param"):
+        return "-"
+    s = a["param"]
+    cands = a.get("candidates")
+    if cands:
+        s += " in [" + ",".join(_fmt(c) for c in cands) + "]"
+    if a.get("op"):
+        s += f" ->{a['op']}"
+    return s
+
+
 def render_doctor(verdicts) -> str:
     if not verdicts:
         return "doctor: no bottleneck found"
@@ -140,9 +156,34 @@ def render_doctor(verdicts) -> str:
         ev_s = ", ".join(f"{k}={ev[k]}" for k in list(ev)[:4])
         rows.append([v.get("bottleneck", "?"),
                      _fmt(v.get("score")), ev_s[:60],
-                     (v.get("knob") or "")[:70]])
+                     (v.get("knob") or "")[:70],
+                     _fmt_action(v.get("action"))[:46]])
     return "doctor verdicts\n" + _table(
-        ["bottleneck", "score", "evidence", "knob"], rows)
+        ["bottleneck", "score", "evidence", "knob", "action"], rows)
+
+
+def render_tuning() -> str:
+    """The unified tuning table with provenance (ISSUE 16): every op's
+    entries from utils.tuning plus who committed each one (source /
+    run / measured improvement) — winners are auditable."""
+    from ..utils import tuning as _tuning
+    ops = _tuning.all_entries()
+    rows = []
+    for op in sorted(ops):
+        for key in sorted(ops[op]):
+            meta = _tuning.provenance(op, key) or {}
+            imp = meta.get("improvement")
+            rows.append([
+                op, "|".join(key), json.dumps(ops[op][key])[:40],
+                meta.get("source", "-"), meta.get("run", "-"),
+                f"+{imp * 100:.2f}%" if isinstance(imp, (int, float))
+                else "-"])
+    if not rows:
+        return (f"tuning table: empty "
+                f"({_tuning.tuning_path() or 'persistence off'})")
+    return (f"tuning table ({_tuning.tuning_path() or 'in-process'})\n"
+            + _table(["op", "key", "value", "source", "run",
+                      "improvement"], rows))
 
 
 def render_snapshot(rec: dict, doctor_rows: Optional[list] = None) -> str:
@@ -228,7 +269,16 @@ def main(argv=None) -> int:
     ap.add_argument("--bundle", help="flight-recorder bundle directory")
     ap.add_argument("--rows", help="BENCH_rows.jsonl (renders the "
                     "latest row's doctor verdicts alongside)")
+    ap.add_argument("--tuning", action="store_true",
+                    help="print the unified tuning table with "
+                         "provenance (source/run/improvement)")
     args = ap.parse_args(argv)
+
+    if args.tuning:
+        print("== paddle_tpu tuning table ==")
+        print(render_tuning())
+        if not (args.snapshot or args.bundle or args.rows):
+            return 0
 
     rec = None
     source = None

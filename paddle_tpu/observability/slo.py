@@ -180,6 +180,10 @@ class SLOMonitor:
         self._window: deque = deque(maxlen=int(window))
         self.breaches = 0
         self.regressions = 0
+        # verdict listeners (ISSUE 16): every check() verdict is pushed
+        # to subscribers — the live autotune retuner's signal feed.  A
+        # listener exception must never take the serving loop down.
+        self._listeners: List = []
         self._g_p99 = metrics.gauge("slo_ttft_ms_p99",
                                     "rolling-window TTFT p99")
         self._g_p50 = metrics.gauge("slo_ttft_ms_p50",
@@ -217,4 +221,15 @@ class SLOMonitor:
             out["regressed"] = True
             self.regressions += 1
             self._c_breach.labels(kind="regression").inc()
+        for cb in self._listeners:
+            try:
+                cb(out)
+            except Exception:
+                pass
         return out
+
+    def add_listener(self, cb) -> "SLOMonitor":
+        """Subscribe ``cb(verdict_dict)`` to every check() result (e.g.
+        a LiveRetuner's ``notify_slo``). Returns self for chaining."""
+        self._listeners.append(cb)
+        return self

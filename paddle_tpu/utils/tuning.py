@@ -45,7 +45,13 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["lookup", "lookup_nearest", "record", "entries", "tuning_path",
            "device_kind", "normalize_kind", "sweep_enabled", "key_str",
-           "reset_for_tests"]
+           "reset_for_tests", "provenance", "all_entries", "META_OP"]
+
+# provenance rides the same flat "<op>|<part>|..." disk encoding under a
+# reserved op namespace: "__meta__|<orig_op>|<part>|..." -> {source, run,
+# improvement}.  Old tables simply have no __meta__ keys; old readers
+# see __meta__ as just another op they never look up.
+META_OP = "__meta__"
 
 _lock = threading.RLock()
 # op -> {key_tuple_of_strs: value}; merged from disk once, sweeps win
@@ -190,12 +196,31 @@ def entries(op: str) -> Dict[Tuple[str, ...], Any]:
         return dict(_STATE["cache"].get(op, {}))
 
 
-def record(op: str, parts, value) -> None:
+def record(op: str, parts, value, *, source: Optional[str] = None,
+           run: Optional[str] = None,
+           improvement: Optional[float] = None) -> None:
     """Record a tuned value: process cache immediately, on-disk table
-    best-effort via atomic read-modify-write (fsync before rename)."""
+    best-effort via atomic read-modify-write (fsync before rename).
+
+    ``source``/``run``/``improvement`` stamp provenance (ISSUE 16):
+    who committed the entry ('sweep' | 'autotune' | 'manual'), under
+    which BENCH_RUN / autotune run id, and the measured improvement
+    fraction over the incumbent it beat.  Provenance lands in the same
+    atomic write as the value — a crash can never commit one without
+    the other."""
+    meta = None
+    if source is not None or run is not None or improvement is not None:
+        meta = {"source": source or "manual"}
+        if run:
+            meta["run"] = str(run)
+        if improvement is not None:
+            meta["improvement"] = round(float(improvement), 6)
     with _lock:
         _load_once()
         _STATE["cache"].setdefault(op, {})[_key_tuple(parts)] = value
+        if meta is not None:
+            _STATE["cache"].setdefault(META_OP, {})[
+                (op,) + _key_tuple(parts)] = meta
         path = tuning_path()
         if not path:
             return
@@ -209,11 +234,31 @@ def record(op: str, parts, value) -> None:
             except (OSError, ValueError):
                 pass  # corrupt table: overwrite with what we know
             data[key_str(op, parts)] = value
+            if meta is not None:
+                data[key_str(META_OP, (op,) + _key_tuple(parts))] = meta
             from ..framework.fs import open_for_write
             with open_for_write(path, "w") as f:
                 json.dump(data, f, indent=0, sort_keys=True)
         except OSError:
             pass
+
+
+def provenance(op: str, parts) -> Optional[Dict[str, Any]]:
+    """The provenance stamp recorded with (op, key), or None (pre-16
+    entries and plain record() calls carry none)."""
+    with _lock:
+        _load_once()
+        m = _STATE["cache"].get(META_OP, {}).get((op,) + _key_tuple(parts))
+        return dict(m) if isinstance(m, dict) else None
+
+
+def all_entries() -> Dict[str, Dict[Tuple[str, ...], Any]]:
+    """Every op's entries (copy), provenance namespace excluded — the
+    report CLI's feed."""
+    with _lock:
+        _load_once()
+        return {op: dict(t) for op, t in _STATE["cache"].items()
+                if op != META_OP}
 
 
 def reset_for_tests() -> None:
