@@ -1330,7 +1330,8 @@ def bench_multichip_child():
     phases = []
     for fn in (multichip.run_zero3_phase, multichip.run_1f1b_phase,
                multichip.run_moe_a2a_phase,
-               multichip.run_elastic_restore_phase):
+               multichip.run_elastic_restore_phase,
+               multichip.run_dcn_phase):
         r = fn()
         phases.append(r)
         log(f"  multichip phase {r['name']} ok t={r['t_s']}s")

@@ -10,7 +10,11 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env,
     is_initialized)
 from .mesh import (  # noqa: F401
-    Mesh, get_mesh, set_mesh, create_mesh, mesh_axis_size)
+    Mesh, get_mesh, set_mesh, create_mesh, mesh_axis_size,
+    dcn_slice_count, slice_size)
+from . import membership  # noqa: F401
+from .membership import (  # noqa: F401
+    SliceMembership, DcnCollectiveGuard, SliceLostError)
 from .collective import (  # noqa: F401
     all_reduce, all_gather, reduce, broadcast, scatter, barrier,
     all_to_all, send, recv, split, ReduceOp, new_group)

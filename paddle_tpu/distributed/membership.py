@@ -1,0 +1,393 @@
+"""Slice membership: heartbeat failure detection for the DCN tier.
+
+The multi-slice mesh (mesh.py's ``dcn`` axis) groups devices into
+slices that fail independently — a whole slice preempted or its DCN
+links dead is the failure unit, not a single chip.  This module is the
+control plane for that tier:
+
+``SliceMembership``
+    One heartbeat record per slice over a pluggable transport.  The
+    file transport touches ``slice.<id>`` files (mtime = last beat)
+    under ``PADDLE_TPU_SLICE_HB_DIR`` — the same idiom as the
+    launcher's per-rank ``hb.<rank>`` files, and the format README
+    documents — so any host on shared storage sees every slice's
+    health.  The in-memory callback transport backs tests and the
+    single-process virtual-slice harness.  ``poll()`` is the failure
+    detector: a slice whose last beat is older than ``timeout_s``
+    transitions to dead exactly once, emitting a membership-change
+    event into the flight recorder, the metrics registry, and to any
+    ``on_change`` listener (SpmdTrainer reacts by re-forming the mesh
+    in memory — see spmd.reform_mesh).
+
+``DcnCollectiveGuard``
+    Timeout + bounded retry with exponential backoff and jitter around
+    cross-slice work — the PADDLE_TPU_FS_RETRIES posture (framework/
+    fs.py) lifted to comms.  A persistently dead peer escalates into a
+    membership change (``SliceLostError``) instead of hanging until
+    the stall watchdog declares the whole loop dead; backoff sleeps
+    are chunked around an ``on_beat`` callback so the watchdog keeps
+    getting fed while the guard is the one doing the waiting.
+
+Env knobs: PADDLE_TPU_SLICE_HB_DIR, PADDLE_TPU_SLICE_HB_TIMEOUT_S
+(default 5), PADDLE_TPU_DCN_RETRIES (default 3),
+PADDLE_TPU_DCN_TIMEOUT_S (default 10), plus the fault points
+PADDLE_FAULT_SLICE_DOWN / PADDLE_FAULT_DCN_DELAY_MS (testing/faults).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["SliceMembership", "FileTransport", "CallbackTransport",
+           "DcnCollectiveGuard", "SliceLostError",
+           "DEFAULT_SLICE_TIMEOUT_S"]
+
+DEFAULT_SLICE_TIMEOUT_S = 5.0
+
+
+class SliceLostError(RuntimeError):
+    """A DCN peer stayed dead through the guard's full retry budget;
+    carries the membership-change event the escalation produced."""
+
+    def __init__(self, msg: str, slice_id: Optional[int] = None,
+                 event: Optional[dict] = None):
+        super().__init__(msg)
+        self.slice_id = slice_id
+        self.event = event
+
+
+class CallbackTransport:
+    """In-memory beat store — tests and the single-process
+    virtual-slice harness (one process hosting every slice)."""
+
+    def __init__(self):
+        self._beats: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def beat(self, slice_id: int, now: float):
+        with self._lock:
+            self._beats[int(slice_id)] = float(now)
+
+    def last_beats(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._beats)
+
+
+class FileTransport:
+    """File-backed beats: ``slice.<id>`` under `directory`, mtime =
+    last beat.  Works across processes/hosts on shared storage; pair
+    with a wall clock (time.time), which is what SliceMembership
+    defaults to."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, slice_id: int) -> str:
+        return os.path.join(self.directory, f"slice.{int(slice_id)}")
+
+    def beat(self, slice_id: int, now: float):
+        p = self._path(slice_id)
+        try:
+            with open(p, "a"):
+                pass
+            os.utime(p, (now, now))
+        except OSError:
+            pass  # a transient beat-write failure is not a death
+
+    def last_beats(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for n in names:
+            if not n.startswith("slice."):
+                continue
+            try:
+                out[int(n[len("slice."):])] = os.path.getmtime(
+                    os.path.join(self.directory, n))
+            except (ValueError, OSError):
+                continue
+        return out
+
+
+class SliceMembership:
+    """Heartbeat registry over the mesh's DCN slices.
+
+    Live slices beat every train step; ``poll()`` flags slices whose
+    last beat is older than ``timeout_s`` and returns one membership
+    event per alive→dead transition.  Slice ids are the ORIGINAL
+    numbering for the life of the object — a reform renumbers mesh
+    rows, not membership ids.
+    """
+
+    def __init__(self, n_slices: int, slice_id: int = 0, transport=None,
+                 timeout_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        if timeout_s is None:
+            timeout_s = float(os.environ.get(
+                "PADDLE_TPU_SLICE_HB_TIMEOUT_S", DEFAULT_SLICE_TIMEOUT_S))
+        self.n_slices = int(n_slices)
+        self.slice_id = int(slice_id)
+        self.timeout_s = float(timeout_s)
+        self.transport = transport if transport is not None \
+            else self._default_transport()
+        self.clock = clock
+        self._dead: set = set()
+        self._events: List[dict] = []
+        self._listeners: List[Callable[[dict], None]] = []
+        self._lock = threading.Lock()
+        # seed every slice as alive NOW: a registry created mid-run must
+        # not declare peers dead before their first beat can land
+        now = self.clock()
+        for s in range(self.n_slices):
+            self.transport.beat(s, now)
+
+    @staticmethod
+    def _default_transport():
+        d = os.environ.get("PADDLE_TPU_SLICE_HB_DIR")
+        return FileTransport(d) if d else CallbackTransport()
+
+    def on_change(self, fn: Callable[[dict], None]):
+        self._listeners.append(fn)
+        return fn
+
+    # ---- beating ------------------------------------------------------
+    def beat(self, slice_id: Optional[int] = None,
+             step: Optional[int] = None) -> bool:
+        """Record a heartbeat for `slice_id` (default: own slice).
+        Honors PADDLE_FAULT_SLICE_DOWN when `step` is given: the armed
+        slice's beats are swallowed from the armed step on, so the
+        failure detector sees a real growing staleness window."""
+        sid = self.slice_id if slice_id is None else int(slice_id)
+        if step is not None:
+            from ..testing import faults as _faults
+            if _faults.slice_is_down(sid, step):
+                return False
+        self.transport.beat(sid, self.clock())
+        return True
+
+    def beat_all(self, step: Optional[int] = None):
+        """Beat every surviving slice — the single-process
+        virtual-slice harness, where one process IS all slices.  Real
+        multi-host deployments call ``beat()`` from each slice's own
+        process instead."""
+        for s in range(self.n_slices):
+            if s not in self._dead:
+                self.beat(s, step=step)
+
+    # ---- detection ----------------------------------------------------
+    def ages(self, now: Optional[float] = None) -> Dict[int, float]:
+        """Seconds since each slice's last beat (None = never seen)."""
+        now = self.clock() if now is None else now
+        beats = self.transport.last_beats()
+        out: Dict[int, float] = {}
+        for s in range(self.n_slices):
+            last = beats.get(s)
+            out[s] = float("inf") if last is None else max(now - last, 0.0)
+        return out
+
+    def dead_slices(self) -> set:
+        return set(self._dead)
+
+    def alive_slices(self) -> List[int]:
+        return [s for s in range(self.n_slices) if s not in self._dead]
+
+    def declare_dead(self, slice_id: int,
+                     reason: str = "escalation") -> Optional[dict]:
+        """Force a membership change — the DCN guard's escalation path
+        (retries exhausted before the heartbeat timeout elapsed).
+        Idempotent: an already-dead slice returns None."""
+        with self._lock:
+            if slice_id in self._dead:
+                return None
+            self._dead.add(int(slice_id))
+            ev = {"kind": "slice_lost", "slice": int(slice_id),
+                  "reason": reason, "wall": time.time(),
+                  "alive": [s for s in range(self.n_slices)
+                            if s not in self._dead]}
+            self._events.append(ev)
+        try:
+            from ..observability import flightrec as _flightrec
+            from ..observability import metrics as _metrics
+            _metrics.counter("slice_lost_total",
+                             "DCN slices declared dead").inc()
+            _flightrec.note_event("membership_change", slice=int(slice_id),
+                                  reason=reason, alive=ev["alive"])
+        except Exception:
+            pass
+        for fn in list(self._listeners):
+            try:
+                fn(ev)
+            except Exception:
+                pass
+        return ev
+
+    def poll(self, now: Optional[float] = None) -> List[dict]:
+        """Failure-detection tick: update the per-slice age gauges and
+        return the membership events for freshly-dead slices (heartbeat
+        age past ``timeout_s``), once per transition."""
+        ages = self.ages(now)
+        try:
+            from ..observability import metrics as _metrics
+            g = _metrics.gauge("slice_heartbeat_age_s",
+                               "seconds since a DCN slice's last heartbeat",
+                               labels=("slice",))
+            for s, age in ages.items():
+                g.labels(slice=str(s)).set(round(min(age, 1e9), 3))
+        except Exception:
+            pass
+        out: List[dict] = []
+        for s, age in ages.items():
+            if age > self.timeout_s and s not in self._dead:
+                ev = self.declare_dead(
+                    s, reason=f"heartbeat_timeout age={age:.3f}s")
+                if ev is not None:
+                    out.append(ev)
+        return out
+
+    @property
+    def events(self) -> List[dict]:
+        return list(self._events)
+
+    def stats(self) -> dict:
+        ages = self.ages()
+        return {
+            "n_slices": self.n_slices,
+            "dead": sorted(self._dead),
+            "timeout_s": self.timeout_s,
+            "heartbeat_ages": {
+                s: (round(a, 3) if a != float("inf") else None)
+                for s, a in ages.items()},
+        }
+
+
+class DcnCollectiveGuard:
+    """Timeout + bounded-retry wrapper for cross-slice (DCN) work.
+
+    ``run(fn, peer_slice=...)`` dispatches fn with: the injected
+    slow-DCN delay (PADDLE_FAULT_DCN_DELAY_MS) applied first like real
+    cross-DC latency; retries on transient comm errors (TimeoutError /
+    OSError, which covers InjectedFault) with exponential backoff and
+    deterministic jitter; a per-attempt deadline — an attempt that
+    finishes but blows ``timeout_s`` is recorded as slow (a doctor
+    signal), not failed; and escalation — retries exhausted turns into
+    ``membership.declare_dead(peer_slice)`` + ``SliceLostError``
+    instead of an indefinite hang.  Backoff sleeps are chunked around
+    ``on_beat`` so the caller's stall watchdog stays fed and the guard
+    escalates before the watchdog fires.
+    """
+
+    RETRYABLE = (TimeoutError, OSError)
+
+    def __init__(self, membership: Optional[SliceMembership] = None,
+                 retries: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 backoff_base_ms: float = 50.0,
+                 backoff_max_ms: float = 2000.0,
+                 jitter: float = 0.25,
+                 on_beat: Optional[Callable[[], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if retries is None:
+            retries = int(os.environ.get("PADDLE_TPU_DCN_RETRIES", "3"))
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("PADDLE_TPU_DCN_TIMEOUT_S",
+                                             "10"))
+        self.membership = membership
+        self.retries = max(1, int(retries))
+        self.timeout_s = float(timeout_s)
+        self.backoff_base_ms = float(backoff_base_ms)
+        self.backoff_max_ms = float(backoff_max_ms)
+        self.jitter = float(jitter)
+        self.on_beat = on_beat
+        self.sleep = sleep
+        self.retries_used = 0
+        self.escalations = 0
+        self.slow_dispatches = 0
+
+    def _beat(self):
+        if self.on_beat is not None:
+            try:
+                self.on_beat()
+            except Exception:
+                pass
+
+    def _backoff(self, attempt: int, label: str):
+        delay = min(self.backoff_max_ms,
+                    self.backoff_base_ms * (2 ** attempt)) / 1e3
+        # deterministic jitter: seeded per (label, attempt) so tests
+        # reproduce exactly while distinct collectives still desync
+        r = random.Random(zlib.crc32(f"{label}:{attempt}".encode()))
+        delay *= 1.0 + self.jitter * r.random()
+        end = time.monotonic() + delay
+        while True:
+            self._beat()  # keep the stall watchdog fed through the wait
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                break
+            self.sleep(min(remaining, 0.25))
+
+    def run(self, fn: Callable, *args, peer_slice: Optional[int] = None,
+            label: str = "dcn-collective", **kwargs):
+        from ..testing import faults as _faults
+        try:
+            from ..observability import flightrec as _flightrec
+        except Exception:  # pragma: no cover
+            _flightrec = None
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            self._beat()
+            _faults.maybe_delay_dcn()
+            t0 = time.monotonic()
+            try:
+                out = fn(*args, **kwargs)
+            except self.RETRYABLE as e:
+                last = e
+                self.retries_used += 1
+                if _flightrec is not None:
+                    _flightrec.note_event(
+                        "dcn_retry", label=label, attempt=attempt + 1,
+                        peer_slice=peer_slice,
+                        error=f"{type(e).__name__}: {str(e)[:120]}")
+                if attempt + 1 < self.retries:
+                    self._backoff(attempt, label)
+                continue
+            dt = time.monotonic() - t0
+            if dt > self.timeout_s:
+                # completed but blew the deadline: a slow DCN is a
+                # doctor signal, not a failure
+                self.slow_dispatches += 1
+                if _flightrec is not None:
+                    _flightrec.note_event("dcn_slow", label=label,
+                                          dt_s=round(dt, 3))
+            return out
+        # retry budget exhausted: escalate to a membership change so
+        # the trainer re-forms the mesh instead of hanging on a dead
+        # peer until the watchdog kills the whole run
+        self.escalations += 1
+        try:
+            from ..observability import metrics as _metrics
+            _metrics.counter("dcn_guard_escalations_total",
+                             "DCN guard retry budgets exhausted").inc()
+        except Exception:
+            pass
+        ev = None
+        if self.membership is not None and peer_slice is not None:
+            ev = self.membership.declare_dead(
+                peer_slice, reason=f"dcn_guard:{label}")
+        raise SliceLostError(
+            f"DCN collective {label!r} failed after {self.retries} "
+            f"attempts ({type(last).__name__ if last else '?'}: {last}); "
+            f"peer slice {peer_slice} escalated to membership change",
+            slice_id=peer_slice, event=ev) from last
+
+    def stats(self) -> dict:
+        return {"retries": self.retries, "timeout_s": self.timeout_s,
+                "retries_used": self.retries_used,
+                "escalations": self.escalations,
+                "slow_dispatches": self.slow_dispatches}

@@ -9,11 +9,19 @@ assignment. Axis-name conventions used across the framework:
 
     dp - data parallel          tp - tensor model parallel
     pp - pipeline stages        sp - sequence/context parallel
-    ep - expert parallel
+    ep - expert parallel        dcn - data-parallel across slices
+
+The `dcn` axis is the multi-slice tier: devices within one slice talk
+over ICI, slices talk over the (much slower) data-center network.
+`create_mesh(..., dcn_slices=N)` (or PADDLE_TPU_DCN_SLICES=N) prepends
+a dcn axis of size N, and sharding the batch over ("dcn", "dp") makes
+GSPMD emit the hierarchical gradient reduce: ICI all-reduce within a
+slice, DCN all-reduce across slices.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -100,22 +108,40 @@ def ppermute(x, axis_name: str, perm):
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "axis_size",
            "all_gather", "reduce_scatter", "ppermute",
            "create_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
-           "default_mesh", "shard_map"]
+           "default_mesh", "shard_map", "dcn_slice_count", "slice_size"]
 
 _current_mesh: Optional[Mesh] = None
 
 
 def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
                 axis_names: Optional[Sequence[str]] = None,
-                devices=None) -> Mesh:
+                devices=None,
+                dcn_slices: Optional[int] = None) -> Mesh:
     """Build a Mesh from {'dp': 2, 'tp': 4} style spec. -1 for one axis
-    means 'all remaining devices'."""
+    means 'all remaining devices'.
+
+    dcn_slices=N (or PADDLE_TPU_DCN_SLICES=N) prepends a "dcn" axis of
+    size N — the mesh becomes N slices of equal shape, dcn-major in
+    device order (slice s owns `devices.reshape(N, -1)[s]`), so ICI
+    collectives group within a slice and dcn-axis collectives cross
+    slices. A spec that already names a "dcn" axis wins over both.
+    """
     if isinstance(axes, dict):
         names = list(axes.keys())
         shape = list(axes.values())
     else:
         shape = list(axes)
         names = list(axis_names or [f"axis{i}" for i in range(len(shape))])
+    if dcn_slices is None:
+        env = os.environ.get("PADDLE_TPU_DCN_SLICES", "").strip()
+        if env:
+            try:
+                dcn_slices = int(env)
+            except ValueError:
+                dcn_slices = None
+    if dcn_slices is not None and int(dcn_slices) >= 1 and "dcn" not in names:
+        names = ["dcn"] + names
+        shape = [int(dcn_slices)] + shape
     devs = np.asarray(devices if devices is not None else jax.devices())
     # deterministic chaos (PADDLE_FAULT_MESH_SHRINK): the scheduler
     # handed back fewer chips — build the mesh from the survivors only,
@@ -124,7 +150,19 @@ def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
     from ..testing import faults as _faults
     _shrink = _faults.mesh_shrink()
     if _shrink is not None and _shrink < devs.size:
-        devs = devs.reshape(-1)[:_shrink]
+        n_dcn = shape[names.index("dcn")] if "dcn" in names else 0
+        if n_dcn > 0:
+            # multi-slice clamp at whole-slice granularity: a ragged
+            # slice (half its chips gone) can't host its shard of the
+            # per-slice axes, so the survivors are the largest whole
+            # number of slices that fit under the clamp — the dcn
+            # extent shrinks, every surviving slice stays intact
+            per_slice = max(devs.size // n_dcn, 1)
+            whole = max((_shrink // per_slice) * per_slice, per_slice)
+            devs = devs.reshape(-1)[:whole]
+            shape[names.index("dcn")] = whole // per_slice
+        else:
+            devs = devs.reshape(-1)[:_shrink]
     n = devs.size
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))
@@ -135,6 +173,18 @@ def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
                          f"devices, only {n} available")
     mesh = Mesh(devs[:total].reshape(shape), tuple(names))
     return mesh
+
+
+def dcn_slice_count(mesh: Mesh) -> int:
+    """Number of DCN slices in the mesh (1 when there is no dcn axis)."""
+    if "dcn" not in mesh.axis_names:
+        return 1
+    return max(int(mesh.shape["dcn"]), 1)
+
+
+def slice_size(mesh: Mesh) -> int:
+    """Devices per DCN slice (the whole mesh when single-slice)."""
+    return mesh.devices.size // dcn_slice_count(mesh)
 
 
 def set_mesh(mesh: Optional[Mesh]):

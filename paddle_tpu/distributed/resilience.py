@@ -77,6 +77,7 @@ class CheckpointManager:
         self._fallbacks = 0
         self._commit_failures = 0
         self._reshard_restores = 0
+        self._reform_waits = 0
         self.last_restore_info: Optional[dict] = None
         self.last_snapshot_ms: Optional[float] = None
         self.last_commit_ms: Optional[float] = None
@@ -93,6 +94,10 @@ class CheckpointManager:
         wait() (or the next save) to join it."""
         self.wait()  # serialize saves + surface any background failure
         # (wait() refuses fast if a previous commit was declared stuck)
+        # a save racing an in-flight mesh reform would snapshot half-
+        # rebound sharding trees: queue behind the reform instead (a
+        # periodic saver thread vs the training thread mid-reform)
+        self._await_reform(trainer)
         if step is None:
             step = getattr(trainer, "_step_count", 0)
         path = self._path_for(step)
@@ -124,6 +129,26 @@ class CheckpointManager:
                 self._commit_failures += 1
                 raise
         return path
+
+    def _await_reform(self, trainer, timeout: Optional[float] = None):
+        """Block while `trainer.reform_in_progress` is set — an
+        in-memory mesh reform owns the trainer state, so a save queues
+        behind it.  Bounded: a reform stuck past the timeout
+        (PADDLE_TPU_REFORM_WAIT_S, default 120s) raises instead of
+        wedging the saver forever."""
+        if not getattr(trainer, "reform_in_progress", False):
+            return
+        if timeout is None:
+            timeout = float(os.environ.get("PADDLE_TPU_REFORM_WAIT_S",
+                                           "120"))
+        self._reform_waits += 1
+        t0 = time.monotonic()
+        while getattr(trainer, "reform_in_progress", False):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"mesh reform still in progress after {timeout}s; "
+                    f"refusing to snapshot mid-reform")
+            time.sleep(0.01)
 
     def wait(self, timeout: Optional[float] = None):
         """Join the in-flight background save; surface its failure —
@@ -230,6 +255,7 @@ class CheckpointManager:
             "fallbacks": self._fallbacks,
             "commit_failures": self._commit_failures,
             "reshard_restores": self._reshard_restores,
+            "reform_waits": self._reform_waits,
             "async": self.async_save,
             "keep_last": self.keep_last,
             "last_snapshot_ms": self.last_snapshot_ms,

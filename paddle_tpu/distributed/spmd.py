@@ -447,6 +447,23 @@ class SpmdTrainer:
         # ---- shardings --------------------------------------------------
         dp_in_mesh = dp_axis in self.mesh.axis_names
         self.dp_size = self.mesh.shape[dp_axis] if dp_in_mesh else 1
+        # multi-slice (DCN) tier: a "dcn" mesh axis makes the batch
+        # shard over ("dcn", dp) — GSPMD then reduces grads ICI-within-
+        # slice + DCN-across-slices while params/optimizer state stay
+        # per-slice (ZeRO shards inside a slice, replicas across)
+        self.dcn_axis = "dcn"
+        self.dcn_size = self.mesh.shape[self.dcn_axis] \
+            if self.dcn_axis in self.mesh.axis_names else 1
+        # membership / in-memory elasticity (attach_membership arms it)
+        self.membership = None
+        self.dcn_guard = None
+        self.reform_in_progress = False
+        self._mesh_reforms = 0
+        self._lost_slices: list = []
+        self._last_reform_info: Optional[dict] = None
+        # membership slice id -> current mesh slice row (reforms
+        # renumber mesh rows; membership ids are stable)
+        self._slice_ids = list(range(self.dcn_size))
         pspecs = build_param_specs(model, self.mesh, dp_axis,
                                    self.zero_stage)
         self._param_specs = pspecs
@@ -461,23 +478,10 @@ class SpmdTrainer:
         # sharding_optimizer assigns `param@accumulator` vars to ranks)
         opt_shapes = jax.eval_shape(self.optimizer.init_state, params)
 
-        def _state_spec(pname):
-            base = pspecs[pname]
-            if self.zero_stage >= 1:
-                shape = tuple(self._param_objs[pname].data.shape)
-                return zero_sharding_spec(shape, base, dp_axis,
-                                          self.dp_size)
-            return base
-
-        def _state_shard(pname, leaf):
-            pshape = tuple(self._param_objs[pname].data.shape)
-            if tuple(leaf.shape) == pshape:
-                return NamedSharding(self.mesh, _state_spec(pname))
-            return self._repl
-
         self._opt_shardings = {
             pname: jax.tree_util.tree_map(
-                lambda leaf, pn=pname: _state_shard(pn, leaf), tree)
+                lambda leaf, pn=pname: self._zero_state_sharding(pn, leaf),
+                tree)
             for pname, tree in opt_shapes.items()}
 
         # place state on the mesh
@@ -535,16 +539,12 @@ class SpmdTrainer:
         # gradient_merge_optimizer.py): ZeRO stage>=2 shards it over dp
         self._grad_buf = None
         if self.k_steps > 1:
-            def _gspec(n):
-                if self.zero_stage >= 2:
-                    return NamedSharding(self.mesh, zero_sharding_spec(
-                        tuple(self._param_objs[n].data.shape), pspecs[n],
-                        dp_axis, self.dp_size))
-                return self._param_shardings[n]
+            self._grad_shardings = {
+                n: self._grad_buf_sharding(n) for n in self.params}
             self._grad_buf = {
-                n: jax.device_put(jnp.zeros_like(a), _gspec(n))
+                n: jax.device_put(jnp.zeros_like(a),
+                                  self._grad_shardings[n])
                 for n, a in self.params.items()}
-            self._grad_shardings = {n: _gspec(n) for n in self.params}
 
         self._compiled: Dict[str, Any] = {}
 
@@ -573,10 +573,48 @@ class SpmdTrainer:
                 _exec_registry.tree_bytes(self._grad_buf))
 
     # ------------------------------------------------------------------
+    def _zero_state_sharding(self, pname, leaf):
+        """Sharding for one optimizer-state leaf: like the param when
+        same-shaped (ZeRO stage>=1 adds a dp dimension), replicated
+        otherwise.  Used at build time (on eval_shape structs) and by
+        the mesh-reform rebind (on live arrays)."""
+        pshape = tuple(self._param_objs[pname].data.shape)
+        if tuple(leaf.shape) == pshape:
+            base = self._param_specs[pname]
+            if self.zero_stage >= 1:
+                return NamedSharding(self.mesh, zero_sharding_spec(
+                    pshape, base, self.dp_axis, self.dp_size))
+            return NamedSharding(self.mesh, base)
+        return self._repl
+
+    def _grad_buf_sharding(self, n):
+        """Sharding of the gradient-merge buffer for param `n` (ZeRO
+        stage>=2 shards it over dp)."""
+        if self.zero_stage >= 2:
+            return NamedSharding(self.mesh, zero_sharding_spec(
+                tuple(self._param_objs[n].data.shape),
+                self._param_specs[n], self.dp_axis, self.dp_size))
+        return self._param_shardings[n]
+
     def _batch_sharding(self, arr):
-        dims = [self.dp_axis if (self.dp_size > 1 and arr.ndim > 0 and
-                                 arr.shape[0] % self.dp_size == 0)
-                else None]
+        # dim 0: hierarchical DP when a dcn axis is live — the batch
+        # shards over ("dcn", dp), which is what makes GSPMD emit the
+        # ICI-within-slice + DCN-across-slices gradient reduce; a batch
+        # only divisible by dp falls back to per-slice DP (replicated
+        # across slices: consistent, just not hierarchical)
+        d0_total = self.dcn_size * self.dp_size
+        if (self.dcn_size > 1 and self.dp_size > 1 and arr.ndim > 0
+                and arr.shape[0] % d0_total == 0):
+            d0 = (self.dcn_axis, self.dp_axis)
+        elif (self.dcn_size > 1 and self.dp_size == 1 and arr.ndim > 0
+                and arr.shape[0] % self.dcn_size == 0):
+            d0 = self.dcn_axis
+        elif (self.dp_size > 1 and arr.ndim > 0 and
+                arr.shape[0] % self.dp_size == 0):
+            d0 = self.dp_axis
+        else:
+            d0 = None
+        dims = [d0]
         # sequence/context parallelism: dim 1 shards over the sp axis
         # (ring attention consumes the blocks; everything else is
         # GSPMD-local)
@@ -627,8 +665,11 @@ class SpmdTrainer:
         AOT lower+compile per executable, done on the FIRST call while
         the args are still alive — the real call may donate them)."""
         from ..utils import comm_stats as _cs
+        ss = self.mesh.devices.size // self.dcn_size \
+            if self.dcn_size > 1 else None
         res = _cs.analyze_jit(self._compiled[key], *args,
-                              device=self.mesh.devices.flat[0])
+                              device=self.mesh.devices.flat[0],
+                              slice_size=ss)
         if res is not None:
             self._comm[key] = res
 
@@ -1118,6 +1159,154 @@ class SpmdTrainer:
         if self._retuner is not None:
             self._retuner.on_step(last)
 
+    # ---- multi-slice membership / in-memory elasticity ---------------
+    def attach_membership(self, membership, guard=None):
+        """Arm slice-loss detection (distributed.membership): every
+        train_step beats the surviving slices this process hosts (the
+        single-process virtual-slice harness; a real multi-host
+        deployment beats only its own slice through the file transport)
+        and polls the failure detector — a membership change triggers
+        the in-memory mesh reform.  `guard` (a DcnCollectiveGuard) is
+        adopted for stats and wired into the same membership object,
+        so a guard escalation reforms exactly like a heartbeat
+        timeout; its backoff waits feed this trainer's stall watchdog.
+        """
+        self.membership = membership
+        self.dcn_guard = guard
+        if guard is not None:
+            if guard.membership is None:
+                guard.membership = membership
+            if guard.on_beat is None:
+                guard.on_beat = self._watchdog_beat
+        return self
+
+    def _membership_tick(self):
+        """Step-boundary membership maintenance: beat, poll, and — on a
+        membership change — re-form the mesh over the survivors before
+        the next step runs."""
+        m = self.membership
+        if m is None:
+            return
+        m.beat_all(step=self._step_count)
+        m.poll()
+        # heartbeat timeouts AND guard escalations both land in
+        # dead_slices(); translate stable membership ids to current
+        # mesh slice rows (reforms renumber rows, ids persist)
+        newly = [sid for sid in sorted(m.dead_slices())
+                 if sid in self._slice_ids]
+        if newly:
+            rows = [self._slice_ids.index(sid) for sid in newly]
+            self.reform_mesh(rows, member_ids=newly)
+
+    def reform_mesh(self, lost_rows, member_ids=None):
+        """In-memory mid-run elasticity: the current step has finished;
+        snapshot the full training state to host (owned copies — the
+        donation-safe checkpoint snapshot), re-form the mesh over the
+        surviving slices, rebuild every sharding tree against it, and
+        re-place the snapshot through the elastic-reshard restore path
+        WITHOUT any checkpoint-dir round trip.  Executables re-register
+        with the observatory on their first post-reform call; the step
+        after that first call is recompile-free again (the
+        zero-recompile contract on the new topology).
+
+        lost_rows: indices into the CURRENT mesh's dcn axis.
+        member_ids: the stable membership ids those rows carry (for
+        stats; defaults to the rows themselves).
+        """
+        from .checkpoint import restore_trainer, snapshot_trainer
+        lost = sorted({int(r) for r in lost_rows})
+        if not lost:
+            return self
+        if self.dcn_size <= 1 or len(lost) >= self.dcn_size:
+            raise RuntimeError(
+                f"cannot re-form mesh: lost slices {lost} of "
+                f"{self.dcn_size} — no survivors")
+        ids = sorted(member_ids) if member_ids else lost
+        t0 = time.perf_counter()
+        self.reform_in_progress = True
+        _flightrec.note_event("mesh_reform_begin", lost_slices=ids,
+                              step=self._step_count,
+                              dcn_from=self.dcn_size)
+        try:
+            state = snapshot_trainer(self)  # host snapshot, owned copies
+            survivors = [r for r in range(self.dcn_size) if r not in lost]
+            # the mesh is dcn-major (create_mesh): slice r owns row r of
+            # the (dcn, -1) device view
+            devs = self.mesh.devices.reshape(self.dcn_size, -1)[survivors]
+            axes = {n: int(self.mesh.shape[n])
+                    for n in self.mesh.axis_names}
+            axes[self.dcn_axis] = len(survivors)
+            new_mesh = Mesh(devs.reshape(list(axes.values())),
+                            tuple(axes.keys()))
+            self._rebind_mesh(new_mesh)
+            # the elastic-reshard restore applied to the in-memory
+            # snapshot: every leaf is re-placed under the NEW shardings
+            # (make_array_from_callback), no disk involved.  elastic is
+            # forced — attaching membership IS the opt-in to mid-run
+            # topology change, regardless of resume_elastic strictness
+            restore_trainer(self, state, elastic=True)
+        finally:
+            self.reform_in_progress = False
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        self._mesh_reforms += 1
+        self._lost_slices.extend(ids)
+        self._slice_ids = [sid for i, sid in enumerate(self._slice_ids)
+                           if i not in lost]
+        self._last_reform_info = {
+            "lost_slices": ids, "dcn_size": self.dcn_size,
+            "step": self._step_count, "ms": round(dur_ms, 2)}
+        _metrics.counter(
+            "mesh_reforms_total",
+            "in-memory mesh re-formations after slice loss").inc()
+        _metrics.gauge(
+            "mesh_reform_ms",
+            "last in-memory mesh reform wall time").set(round(dur_ms, 3))
+        _flightrec.note_event("mesh_reform", lost_slices=ids,
+                              dcn_size=self.dcn_size,
+                              step=self._step_count, ms=round(dur_ms, 2))
+        return self
+
+    def _rebind_mesh(self, mesh):
+        """Rebuild every sharding tree and drop the compiled-executable
+        cache for a NEW mesh (the reform path).  State arrays still
+        live under the old placement afterwards — the caller re-places
+        them (restore_trainer over the host snapshot)."""
+        self.mesh = mesh
+        self.dp_size = mesh.shape[self.dp_axis] \
+            if self.dp_axis in mesh.axis_names else 1
+        self.dcn_size = mesh.shape[self.dcn_axis] \
+            if self.dcn_axis in mesh.axis_names else 1
+        pspecs = build_param_specs(self.model, mesh, self.dp_axis,
+                                   self.zero_stage)
+        self._param_specs = pspecs
+        self._param_shardings = {
+            n: NamedSharding(mesh, s) for n, s in pspecs.items()}
+        self._buffer_shardings = {
+            n: NamedSharding(mesh, PartitionSpec())
+            for n in self.buffers}
+        self._repl = NamedSharding(mesh, PartitionSpec())
+        self._opt_shardings = {
+            pname: jax.tree_util.tree_map(
+                lambda leaf, pn=pname: self._zero_state_sharding(pn, leaf),
+                tree)
+            for pname, tree in self.opt_state.items()}
+        if self._scaler_state is not None:
+            self._scaler_shardings = {k: self._repl
+                                      for k in self._scaler_state}
+        if self._anomaly_state is not None:
+            self._anomaly_shardings = {k: self._repl
+                                       for k in self._anomaly_state}
+        if self._grad_buf is not None:
+            self._grad_shardings = {
+                n: self._grad_buf_sharding(n) for n in self.params}
+        # new mesh => new executables: drop the compiled cache so the
+        # first post-reform call compiles once, and clear the first-call
+        # markers so compile-vs-dispatch attribution and exec-registry
+        # re-registration behave like a fresh trainer
+        self._compiled.clear()
+        self._first_call_keys.clear()
+        self._comm.clear()
+
     # ------------------------------------------------------------------
     def train_step(self, inputs, labels, return_outputs=False):
         """Run one compiled training step. inputs/labels: array, Tensor,
@@ -1196,6 +1385,7 @@ class SpmdTrainer:
             _faults.maybe_sigterm(self._step_count)
             _faults.maybe_hang(self._step_count)
             self._telemetry_step_end()
+            self._membership_tick()
             result = StepResult(loss, timings=self._timings, outputs=outs)
             return (result, outs) if return_outputs else result
         if return_outputs:
@@ -1242,6 +1432,7 @@ class SpmdTrainer:
         _faults.maybe_sigterm(self._step_count)
         _faults.maybe_hang(self._step_count)
         self._telemetry_step_end()
+        self._membership_tick()
         return StepResult(loss, timings=self._timings)
 
     def eval_step(self, inputs):
@@ -1388,7 +1579,21 @@ class SpmdTrainer:
         s = {"anomaly_policy": self.anomaly_policy,
              "rollback_steps": self._rollback_count,
              "resume_elastic": self.resume_elastic,
-             "reshard_restores": self._reshard_restores}
+             "reshard_restores": self._reshard_restores,
+             # multi-slice tier: how many in-memory reforms ran, which
+             # membership slice ids were lost, and the live dcn extent
+             "mesh_reforms": self._mesh_reforms,
+             "lost_slices": list(self._lost_slices),
+             "dcn_slices": self.dcn_size}
+        if self._last_reform_info is not None:
+            s["last_reform"] = dict(self._last_reform_info)
+        if self.membership is not None:
+            ms = self.membership.stats()
+            s["slice_heartbeat_ages"] = ms["heartbeat_ages"]
+            s["slice_timeout_s"] = ms["timeout_s"]
+            s["slices_dead"] = ms["dead"]
+        if self.dcn_guard is not None:
+            s["dcn_guard"] = self.dcn_guard.stats()
         t_sync = time.perf_counter()
         if self._anomaly_state is not None:
             s["skipped_steps"] = int(self._anomaly_state["skipped"])
@@ -1418,6 +1623,8 @@ class SpmdTrainer:
         # that actually hides its collectives shows the fraction shrink
         # instead of the step time growing
         comm_ms = comm_bytes = comm_count = 0.0
+        comm_ici = comm_dcn = 0.0
+        comm_split = False
         by_op: Dict[str, dict] = {}
         # one per-step executable counts (the most recently analyzed
         # fused/accum variant — 'fused' and 'fused_out' are the SAME
@@ -1435,14 +1642,27 @@ class SpmdTrainer:
             comm_ms += res["comm_ms"] * scale
             comm_bytes += res["bytes"] * scale
             comm_count += res["count"] * scale
+            if "dcn_bytes" in res:
+                comm_split = True
+                comm_ici += res["ici_bytes"] * scale
+                comm_dcn += res["dcn_bytes"] * scale
             for op, v in res["by_op"].items():
                 slot = by_op.setdefault(op, {"count": 0.0, "bytes": 0.0})
                 slot["count"] += v["count"] * scale
                 slot["bytes"] += v["bytes"] * scale
+                if "dcn_bytes" in v:
+                    slot["ici_bytes"] = slot.get("ici_bytes", 0.0) \
+                        + v["ici_bytes"] * scale
+                    slot["dcn_bytes"] = slot.get("dcn_bytes", 0.0) \
+                        + v["dcn_bytes"] * scale
         s["comm_ms"] = round(comm_ms, 4) if self._comm else None
         s["comm_bytes"] = int(comm_bytes) if self._comm else None
         s["comm_collectives"] = int(comm_count) if self._comm else None
         s["comm_by_op"] = by_op if self._comm else None
+        # ici/dcn byte split (multi-slice meshes with comm stats on):
+        # the evidence for the dcn-bound doctor rule and the dcn phase
+        s["comm_bytes_ici"] = int(comm_ici) if comm_split else None
+        s["comm_bytes_dcn"] = int(comm_dcn) if comm_split else None
         steps = self._timings["steps_timed"]
         mean_step = (self._timings["dispatch_ms"] / steps) if steps else 0.0
         s["comm_fraction"] = round(comm_ms / mean_step, 4) \

@@ -49,6 +49,10 @@ PREFIX_QUERIES_MIN = 20
 SLOT_OCCUPANCY_MIN = 0.5
 # roofline/ledger rules (exec registry evidence, ISSUE 15)
 HBM_BW_FRAC_MIN = 0.5      # decode pushing >= half the HBM roof
+# multi-slice (DCN) tier rules
+SLICE_AGE_FRAC_MIN = 0.5   # heartbeat age past half the slice timeout
+DCN_SHARE_MIN = 0.4        # DCN bytes >= this share of collective bytes
+DCN_COMM_FRACTION_MIN = 0.15
 from .exec_registry import MFU_TARGET as MFU_GAP_MIN          # noqa: E402
 from .exec_registry import OOM_HEADROOM_MIN as HBM_HEADROOM_MIN  # noqa: E402
 # (one source of truth: the registry's attribution target and the
@@ -381,7 +385,74 @@ def _oom_action(s: dict, ev: dict) -> dict:
             "candidates": ["full", "dots"]}
 
 
+def _slice_unhealthy(s: dict):
+    """A DCN slice's heartbeat is stale (past half its timeout) or
+    already declared dead — the membership layer is about to (or did)
+    escalate; evidence names the worst slice so an operator can find
+    the sick hosts before the reform, not after."""
+    ages = s.get("slice_heartbeat_ages")
+    timeout = _num(s, "slice_timeout_s")
+    if not isinstance(ages, dict) or not ages or not timeout \
+            or timeout <= 0:
+        return None
+    worst_id, worst = None, -1.0
+    for sid, age in ages.items():
+        if isinstance(age, (int, float)) and not isinstance(age, bool) \
+                and float(age) > worst:
+            worst_id, worst = sid, float(age)
+    dead = s.get("slices_dead") or []
+    if worst_id is None and not dead:
+        return None
+    frac = (worst / timeout) if worst >= 0 else 0.0
+    if frac < SLICE_AGE_FRAC_MIN and not dead:
+        return None
+    ev = {"timeout_s": timeout}
+    if worst_id is not None:
+        ev["slice"] = worst_id
+        ev["heartbeat_age_s"] = round(worst, 3)
+    if dead:
+        ev["slices_dead"] = list(dead)
+    reforms = _num(s, "mesh_reforms")
+    if reforms:
+        ev["mesh_reforms"] = int(reforms)
+    score = max(frac, 1.0) if dead else frac
+    return ev, min(score, 2.0)
+
+
+def _dcn_bound(s: dict):
+    """Cross-slice (DCN) all-reduce dominates the collective bytes AND
+    communication is a real share of the step: the slow tier is the
+    bottleneck — sync less often or move less across slices."""
+    dcn_b = _num(s, "comm_bytes_dcn")
+    total = _num(s, "comm_bytes")
+    cf = _num(s, "comm_fraction")
+    if not dcn_b or not total or total <= 0 or cf is None:
+        return None
+    share = dcn_b / total
+    if share < DCN_SHARE_MIN or cf < DCN_COMM_FRACTION_MIN:
+        return None
+    ev = {"dcn_bytes": int(dcn_b), "comm_bytes": int(total),
+          "dcn_share": round(share, 4), "comm_fraction": round(cf, 4)}
+    return ev, min(cf * (1.0 + share), 2.0)
+
+
 RULES: List[Rule] = [
+    Rule("slice-unhealthy", ("train",),
+         "a DCN slice's heartbeat is stale: check its hosts / expect an "
+         "in-memory mesh reform (lost-slice reshard); tune "
+         "PADDLE_TPU_SLICE_HB_TIMEOUT_S for the detection window",
+         _slice_unhealthy,
+         # behavioral/operational: no tuning-table axis moves this
+         action={"op": None, "param": None,
+                 "env": "PADDLE_TPU_SLICE_HB_TIMEOUT_S",
+                 "candidates": []}),
+    Rule("dcn-bound", ("train",),
+         "cross-slice all-reduce dominates: gradient_merge (k_steps) to "
+         "sync across slices less often / larger per-slice batch / keep "
+         "overlap on (PADDLE_TPU_OVERLAP=1)",
+         _dcn_bound,
+         action={"op": None, "param": "k_steps", "env": None,
+                 "candidates": [2, 4, 8]}),
     Rule("comm-bound", ("train",),
          "PADDLE_TPU_OVERLAP=1 / MoELayer a2a_chunks "
          "(PADDLE_TPU_MOE_A2A_CHUNKS) / revisit sharding stage",

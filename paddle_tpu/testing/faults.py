@@ -45,6 +45,18 @@ PADDLE_FAULT_HANG="step:seconds"
     watchdog's stall-detection tests.  The sleep happens ON the step
     loop's thread, exactly like a wedged collective or a dead remote
     store would.
+PADDLE_FAULT_SLICE_DOWN="slice:step"
+    The armed DCN slice goes dark from train step `step` on
+    (1-indexed): membership-aware beats for that slice are swallowed,
+    so the failure detector sees a real growing staleness window,
+    declares the slice dead, and the trainer's in-memory mesh reform
+    runs — a deterministic whole-slice loss without killing the test
+    process.  Multi-host deployments can instead just stop the slice's
+    processes; the heartbeat file going stale has the same effect.
+PADDLE_FAULT_DCN_DELAY_MS="ms"
+    Sleep ms milliseconds inside every DCN collective guard dispatch —
+    deterministic slow-DCN jitter for the guard's retry/timeout tests.
+    Composes with PADDLE_FAULT_SLICE_DOWN.
 """
 from __future__ import annotations
 
@@ -57,7 +69,8 @@ from typing import Optional
 __all__ = ["InjectedFault", "maybe_fail_fs", "nan_poison_step",
            "maybe_kill_worker", "maybe_sigterm", "reset",
            "ckpt_truncate_commit", "mesh_shrink", "maybe_delay_fs",
-           "maybe_hang", "flightrec_dump"]
+           "maybe_hang", "flightrec_dump", "slice_down", "slice_is_down",
+           "maybe_delay_dcn"]
 
 
 class InjectedFault(IOError):
@@ -218,6 +231,41 @@ def maybe_delay_fs(op: str):
         if delay > 0:
             time.sleep(delay / 1e3)
         return
+
+
+def slice_down() -> Optional[tuple]:
+    """(slice_id, step) parsed from PADDLE_FAULT_SLICE_DOWN, or None."""
+    spec = os.environ.get("PADDLE_FAULT_SLICE_DOWN")
+    if not spec or ":" not in spec:
+        return None
+    sid_s, _, step_s = spec.partition(":")
+    try:
+        return int(sid_s), int(step_s)
+    except ValueError:
+        return None
+
+
+def slice_is_down(slice_id: int, step: int) -> bool:
+    """Fault point for slice heartbeats: True when the armed slice must
+    stay silent at `step` (silent from the armed step onward, so the
+    heartbeat age grows monotonically like a real dead slice's)."""
+    armed = slice_down()
+    return armed is not None and slice_id == armed[0] and step >= armed[1]
+
+
+def maybe_delay_dcn():
+    """Delay point inside the DCN collective guard's dispatch
+    (PADDLE_FAULT_DCN_DELAY_MS): deterministic cross-slice latency; the
+    collective still succeeds."""
+    v = os.environ.get("PADDLE_FAULT_DCN_DELAY_MS")
+    if not v:
+        return
+    try:
+        ms = float(v)
+    except ValueError:
+        return
+    if ms > 0:
+        time.sleep(ms / 1e3)
 
 
 def maybe_sigterm(step: int):

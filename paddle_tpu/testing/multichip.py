@@ -26,7 +26,7 @@ from typing import Dict, List
 import numpy as np
 
 __all__ = ["run_zero3_phase", "run_1f1b_phase", "run_moe_a2a_phase",
-           "run_elastic_restore_phase", "PARITY_RTOL"]
+           "run_elastic_restore_phase", "run_dcn_phase", "PARITY_RTOL"]
 
 # fp32 loss parity between a schedule and its synchronous counterpart
 PARITY_RTOL = 1e-5
@@ -264,6 +264,79 @@ def run_elastic_restore_phase(steps: int = 3,
         "max_rel_diff": _parity(loss_ref, resumed, "elastic_restore"),
         "reshard_restores": mgr2.stats["reshard_restores"],
         "compiles_steps_2plus": compiles,
+    }
+
+
+def run_dcn_phase(steps: int = 3, slices: int = 2) -> Dict:
+    """Hierarchical data parallelism (ISSUE 17): flat dp over all
+    devices vs a ('dcn', 'dp') mesh — dense all-reduce within a slice
+    over ICI, only the cross-slice grad reduce over DCN.  Loss parity
+    at PARITY_RTOL, zero recompiles in steps 2+, and the comm split
+    must attribute bytes to BOTH tiers (that IS the hierarchy)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import SpmdTrainer, create_mesh
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.utils import compile_counter
+
+    t0 = time.perf_counter()
+    n = len(jax.devices())
+    if n % slices != 0 or n // slices < 2:
+        slices = 2 if n % 2 == 0 and n >= 4 else 1
+    crit = GPTPretrainingCriterion()
+    rng = np.random.RandomState(17)
+    ids = rng.randint(0, 128, (n * 2, 32)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+
+    def run(hier):
+        paddle.seed(9)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=32,
+                        use_flash_attention=False)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        st = DistributedStrategy()
+        st.sharding = True
+        # ZeRO shards optimizer state over dp WITHIN a slice, so the
+        # hierarchical program carries guaranteed intra-slice (ICI)
+        # gathers next to the cross-slice (DCN) grad reduce
+        st.sharding_configs = {"stage": 3, "overlap": False}
+        mesh = create_mesh({"dp": n // slices}, dcn_slices=slices) \
+            if hier else create_mesh({"dp": n})
+        tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l),
+                         mesh=mesh, strategy=st, comm_stats=hier)
+        losses = [float(tr.train_step(ids, labels))]
+        snap = compile_counter.snapshot()
+        for _ in range(steps - 1):
+            losses.append(float(tr.train_step(ids, labels)))
+        return losses, snap.new_compiles, tr.stats
+
+    loss_flat, _, _ = run(False)
+    loss_hier, compiles, stats = run(True)
+    _assert_comm_fields(stats, "dcn")
+    assert compiles == 0, \
+        f"dcn hierarchical: {compiles} XLA compiles in steps 2..{steps}"
+    assert stats.get("dcn_slices") == slices, \
+        f"dcn: expected {slices} slices in stats, {stats.get('dcn_slices')}"
+    ici, dcn = stats.get("comm_bytes_ici"), stats.get("comm_bytes_dcn")
+    if slices > 1:
+        assert ici and ici > 0, f"dcn: no ICI bytes attributed ({ici})"
+        assert dcn and dcn > 0, f"dcn: no DCN bytes attributed ({dcn})"
+    by_op = stats["comm_by_op"] or {}
+    return {
+        "name": "dcn_hierarchical",
+        "t_s": round(time.perf_counter() - t0, 1),
+        "dcn_slices": slices, "dp_per_slice": n // max(slices, 1),
+        "loss_sync": loss_flat, "loss_overlap": loss_hier,
+        "max_rel_diff": _parity(loss_flat, loss_hier, "dcn"),
+        "compiles_steps_2plus": compiles,
+        "comm_ms": stats["comm_ms"],
+        "comm_fraction": stats["comm_fraction"],
+        "comm_bytes_ici": ici, "comm_bytes_dcn": dcn,
+        "comm_by_op": {k: v["count"] for k, v in by_op.items()},
     }
 
 

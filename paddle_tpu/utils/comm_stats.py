@@ -29,8 +29,8 @@ import warnings
 from typing import Dict, Optional
 
 __all__ = ["parse_hlo_collectives", "estimate_comm_ms",
-           "analyze_compiled", "analyze_jit", "empty_breakdown",
-           "COLLECTIVE_KINDS"]
+           "estimate_dcn_ms", "analyze_compiled", "analyze_jit",
+           "empty_breakdown", "COLLECTIVE_KINDS"]
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
                     "all-to-all", "collective-permute")
@@ -125,7 +125,61 @@ def _while_multipliers(lines_by_comp):
     return {comp: mult(comp) for comp in lines_by_comp}
 
 
-def parse_hlo_collectives(hlo_text: str) -> Dict:
+# `replica_groups={{0,1,2,3},{4,5,6,7}}` (explicit) and the iota form
+# `replica_groups=[4,2]<=[2,4]T(1,0)` (v2: groups-by-rows of an iota
+# reshaped to dims, optionally transposed).
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{(.*?)\}\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+
+
+def _parse_replica_groups(line: str):
+    """Device-id groups of one collective line, or None when the op
+    carries no/empty replica_groups (= one group of every device)."""
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        groups = []
+        for part in m.group(1).split("},{"):
+            ids = [int(x) for x in part.replace(" ", "").split(",")
+                   if x.lstrip("-").isdigit()]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        n = 1
+        for d in dims:
+            n *= d
+        ids = list(range(n))
+        if m.group(4):
+            import numpy as _np
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = _np.arange(n).reshape(dims).transpose(perm) \
+                .reshape(-1).tolist()
+        if n_groups * group_size == n:
+            return [ids[i * group_size:(i + 1) * group_size]
+                    for i in range(n_groups)]
+    return None
+
+
+def _crosses_slice(groups, slice_size: int) -> bool:
+    """True when any replica group spans two DCN slices (device id //
+    slice_size).  No groups recorded means one global group — that
+    crosses slices whenever the caller asks (slice_size is only passed
+    on a multi-slice mesh)."""
+    if not groups:
+        return True
+    for g in groups:
+        if len({d // slice_size for d in g}) > 1:
+            return True
+    return False
+
+
+def parse_hlo_collectives(hlo_text: str,
+                          slice_size: Optional[int] = None) -> Dict:
     """Scan optimized HLO for collective ops.
 
     Returns {"count": int, "bytes": int, "by_op": {kind: {"count", "bytes"}}}
@@ -133,7 +187,13 @@ def parse_hlo_collectives(hlo_text: str) -> Dict:
     the tuple-carrying `-start` intermediates are not double counted,
     and a collective inside a while/scan body counts once per loop trip
     (the scanned schedules — ZeRO-3 layer gathers, 1F1B tick ppermutes
-    — would otherwise underreport by the trip count)."""
+    — would otherwise underreport by the trip count).
+
+    slice_size (devices per DCN slice) additionally splits every op's
+    bytes into "ici_bytes" (replica groups contained in one slice) vs
+    "dcn_bytes" (groups spanning slices — the cross-datacenter-network
+    traffic), per kind and as top-level totals: the evidence the
+    hierarchical-DP parity phase and the dcn-bound doctor rule read."""
     lines_by_comp: Dict[str, list] = {"": []}
     comp = ""
     for line in hlo_text.splitlines():
@@ -147,20 +207,36 @@ def parse_hlo_collectives(hlo_text: str) -> Dict:
         lines_by_comp.setdefault(comp, []).append(line)
     mults = _while_multipliers(lines_by_comp)
 
+    split = slice_size is not None and slice_size > 0
     by_op = {k: {"count": 0, "bytes": 0} for k in COLLECTIVE_KINDS}
+    if split:
+        for v in by_op.values():
+            v["ici_bytes"] = 0
+            v["dcn_bytes"] = 0
     for comp, lines in lines_by_comp.items():
         scale = mults.get(comp, 1)
         for line in lines:
             for m in _OP_RE.finditer(line):
                 kind = m.group("kind")
-                by_op[kind]["count"] += scale
-                by_op[kind]["bytes"] += scale * _shape_bytes(
+                b = scale * _shape_bytes(
                     m.group("shape"),
                     async_start=bool(m.group("async")), kind=kind)
+                by_op[kind]["count"] += scale
+                by_op[kind]["bytes"] += b
+                if split:
+                    cross = _crosses_slice(
+                        _parse_replica_groups(line), slice_size)
+                    by_op[kind]["dcn_bytes" if cross else "ici_bytes"] += b
     total_c = sum(v["count"] for v in by_op.values())
     total_b = sum(v["bytes"] for v in by_op.values())
-    return {"count": total_c, "bytes": total_b,
-            "by_op": {k: v for k, v in by_op.items() if v["count"]}}
+    out = {"count": total_c, "bytes": total_b,
+           "by_op": {k: v for k, v in by_op.items() if v["count"]}}
+    if split:
+        out["ici_bytes"] = sum(v["ici_bytes"]
+                               for v in out["by_op"].values())
+        out["dcn_bytes"] = sum(v["dcn_bytes"]
+                               for v in out["by_op"].values())
+    return out
 
 
 # public per-chip ICI bandwidth figures (GB/s, order-of-magnitude — the
@@ -188,6 +264,20 @@ def estimate_comm_ms(n_bytes: int, device=None) -> float:
     """Transfer-time estimate for `n_bytes` per-device collective bytes
     under the bandwidth model (PADDLE_TPU_ICI_GBPS overrides)."""
     bw = _bandwidth_gbps(device) * 1e9
+    return (n_bytes / bw) * 1e3 if bw > 0 else 0.0
+
+
+# cross-slice (data-center network) bandwidth is roughly an order of
+# magnitude below ICI; public multislice figures put per-chip DCN at
+# ~25 GB/s — a model for the fraction, not a benchmark.
+_DCN_GBPS = 25.0
+
+
+def estimate_dcn_ms(n_bytes: int) -> float:
+    """Transfer-time estimate for `n_bytes` of cross-slice (DCN)
+    collective bytes (PADDLE_TPU_DCN_GBPS overrides)."""
+    env = os.environ.get("PADDLE_TPU_DCN_GBPS")
+    bw = (float(env) if env else _DCN_GBPS) * 1e9
     return (n_bytes / bw) * 1e3 if bw > 0 else 0.0
 
 
@@ -230,21 +320,33 @@ def _degraded(stage: str, exc: BaseException) -> Dict:
     return empty_breakdown(err)
 
 
-def analyze_compiled(compiled, device=None) -> Dict:
+def analyze_compiled(compiled, device=None,
+                     slice_size: Optional[int] = None) -> Dict:
     """Collective breakdown + comm_ms estimate of one compiled XLA
     executable (a `jax.stages.Compiled`).  Never raises: a backend
     where ``as_text``/parsing fails yields ``empty_breakdown()`` with a
-    warn-once + failure counter instead of propagating mid-training."""
+    warn-once + failure counter instead of propagating mid-training.
+
+    slice_size enables the ici/dcn byte split (see
+    parse_hlo_collectives); comm_ms then prices ICI and DCN bytes at
+    their own bandwidths instead of pretending the slow tier is ICI."""
     try:
         txt = compiled.as_text()
-        out = parse_hlo_collectives(txt)
-        out["comm_ms"] = round(estimate_comm_ms(out["bytes"], device), 4)
+        out = parse_hlo_collectives(txt, slice_size=slice_size)
+        if "dcn_bytes" in out:
+            out["comm_ms"] = round(
+                estimate_comm_ms(out["ici_bytes"], device)
+                + estimate_dcn_ms(out["dcn_bytes"]), 4)
+        else:
+            out["comm_ms"] = round(
+                estimate_comm_ms(out["bytes"], device), 4)
         return out
     except Exception as e:
         return _degraded("analyze_compiled", e)
 
 
-def analyze_jit(jitfn, *args, device=None) -> Optional[Dict]:
+def analyze_jit(jitfn, *args, device=None,
+                slice_size: Optional[int] = None) -> Optional[Dict]:
     """AOT lower+compile `jitfn` at `args` (values or ShapeDtypeStructs)
     and analyze its collectives.  Returns None when lowering/compiling
     fails (the caller's step still runs; stats just stay unmeasured,
@@ -255,4 +357,4 @@ def analyze_jit(jitfn, *args, device=None) -> Optional[Dict]:
     except Exception as e:
         _degraded("analyze_jit", e)
         return None
-    return analyze_compiled(compiled, device=device)
+    return analyze_compiled(compiled, device=device, slice_size=slice_size)

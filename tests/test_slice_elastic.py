@@ -1,0 +1,162 @@
+"""Slice-loss kill-and-resume, end to end (ISSUE 17 acceptance run).
+
+A fresh process trains a 2-slice hierarchical-dp GPT; the fault
+harness silences slice 1 mid-run (PADDLE_FAULT_SLICE_DOWN); the
+membership layer detects the stale heartbeat and the trainer re-forms
+the mesh IN MEMORY onto the surviving slice — no checkpoint directory,
+no process restart — and keeps training.  The parent asserts:
+
+- the full loss curve matches an uninterrupted 2-slice reference run
+  (rtol 1e-5);
+- zero XLA compiles after the first (expected, new-topology) post-
+  reform step;
+- the flight-recorder bundle the child dumps carries both the
+  ``membership_change`` and the ``mesh_reform`` events — the black box
+  a real slice loss must leave behind.
+
+Mirrors tests/test_elastic.py's subprocess pattern (same env scrub,
+same 8-virtual-device CPU topology).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SLICE_TRAIN = """
+import json
+import os
+import sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import SpmdTrainer, create_mesh
+from paddle_tpu.distributed.membership import (SliceMembership,
+                                               CallbackTransport,
+                                               DcnCollectiveGuard)
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                               GPTPretrainingCriterion)
+from paddle_tpu.utils import compile_counter
+from paddle_tpu.observability import flightrec
+
+mode = sys.argv[1]
+N = 7
+
+paddle.seed(3)
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                num_heads=2, max_seq_len=16, use_flash_attention=False)
+model = GPTForCausalLM(cfg)
+opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                            parameters=model.parameters())
+crit = GPTPretrainingCriterion()
+tr = SpmdTrainer(model, opt, lambda o, l: crit(o, l),
+                 mesh=create_mesh({"dp": 4}, dcn_slices=2))
+print("DCN", tr.dcn_size, flush=True)
+
+t = {"now": 0.0}
+m = SliceMembership(2, transport=CallbackTransport(), timeout_s=1.0,
+                    clock=lambda: t["now"])
+tr.attach_membership(m, guard=DcnCollectiveGuard(retries=2))
+
+rng = np.random.RandomState(0)
+data = []
+for _ in range(N):
+    b = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    data.append((b, np.roll(b, -1, 1).astype(np.int64)))
+
+snap = None
+for i, (b, l) in enumerate(data):
+    print("LOSS", repr(float(tr.train_step(b, l))), flush=True)
+    if mode == "faulted" and i == 2:
+        t["now"] += 5.0   # slice 1 is armed silent: its age now grows
+    if i == 4:
+        # faulted: the reform ran at the end of step 3 and step 4 paid
+        # the one new-topology compile; everything after must not
+        snap = compile_counter.snapshot()
+print("COMPILES_AFTER", snap.new_compiles, flush=True)
+if mode == "faulted":
+    path = flightrec.dump("slice-loss-test")
+    print("BUNDLE", path, flush=True)
+print("STATS", json.dumps({
+    "mesh_reforms": tr.stats["mesh_reforms"],
+    "lost_slices": tr.stats["lost_slices"],
+    "dcn_slices": tr.stats["dcn_slices"],
+    "devices": int(tr.mesh.devices.size)}), flush=True)
+print("DONE", tr._step_count, flush=True)
+"""
+
+
+def _run_child(script, mode, extra_env, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=8"])
+    for k in ("PADDLE_FAULT_SIGTERM_STEP", "PADDLE_FAULT_MESH_SHRINK",
+              "PADDLE_FAULT_NAN_STEP", "PADDLE_FAULT_CKPT_TRUNCATE",
+              "PADDLE_FAULT_SLICE_DOWN", "PADDLE_FAULT_DCN_DELAY_MS",
+              "PADDLE_TPU_DCN_SLICES", "PADDLE_TPU_SLICE_HB_DIR",
+              "PADDLE_TPU_FLIGHTREC_DIR"):
+        env.pop(k, None)
+    env.update(extra_env)
+    return subprocess.run([sys.executable, str(script), mode],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _losses(stdout):
+    return [float(ln.split(" ", 1)[1]) for ln in stdout.splitlines()
+            if ln.startswith("LOSS")]
+
+
+def _field(stdout, tag):
+    for ln in stdout.splitlines():
+        if ln.startswith(tag + " "):
+            return ln.split(" ", 1)[1].strip()
+    raise AssertionError(f"{tag} line missing from child stdout")
+
+
+def test_subprocess_slice_loss_reforms_and_resumes(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(_SLICE_TRAIN)
+    frdir = str(tmp_path / "flightrec")
+
+    p_ref = _run_child(script, "ref", {})
+    assert p_ref.returncode == 0, p_ref.stderr
+    ref = _losses(p_ref.stdout)
+    assert len(ref) == 7 and "DCN 2" in p_ref.stdout
+    ref_stats = json.loads(_field(p_ref.stdout, "STATS"))
+    assert ref_stats["mesh_reforms"] == 0 and ref_stats["devices"] == 8
+
+    p = _run_child(script, "faulted",
+                   {"PADDLE_FAULT_SLICE_DOWN": "1:3",
+                    "PADDLE_TPU_FLIGHTREC_DIR": frdir})
+    assert p.returncode == 0, p.stderr
+    assert "DONE 7" in p.stdout
+
+    # the in-memory reform resumed with the uninterrupted loss curve
+    np.testing.assert_allclose(_losses(p.stdout), ref, rtol=1e-5)
+
+    # zero-recompile contract on the survivor topology
+    assert _field(p.stdout, "COMPILES_AFTER") == "0"
+
+    stats = json.loads(_field(p.stdout, "STATS"))
+    assert stats["mesh_reforms"] == 1 and stats["lost_slices"] == [1]
+    assert stats["dcn_slices"] == 1 and stats["devices"] == 4
+
+    # the black box: one bundle, carrying both event kinds
+    from paddle_tpu.observability import flightrec
+    bundle_path = _field(p.stdout, "BUNDLE")
+    assert bundle_path != "None", "flightrec bundle was not written"
+    doc = flightrec.load_bundle(bundle_path)
+    kinds = [e["kind"] for e in doc["bundle"]["events"]]
+    assert "membership_change" in kinds, kinds
+    assert "mesh_reform" in kinds, kinds
+    reform = [e for e in doc["bundle"]["events"]
+              if e["kind"] == "mesh_reform"][0]
+    assert reform["lost_slices"] == [1] and reform["dcn_size"] == 1
