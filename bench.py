@@ -153,7 +153,8 @@ def _serve_row_key(row) -> tuple:
             int(row.get("batch_slots", 0)),
             str(row.get("kv_dtype") or "dense"),
             bool(row.get("decode_megakernel")),
-            int(row.get("prompt_len", 0)), int(row.get("gen_tokens", 0)))
+            int(row.get("prompt_len", 0)), int(row.get("gen_tokens", 0)),
+            int(row.get("tp", 1) or 1))
 
 
 def _measured_rows(kind) -> dict:
@@ -719,7 +720,8 @@ def _serve_sweep():
     for mk in (False, True):
         key = ("serve", config, _serve_slots(), kv_dtype, mk,
                _SERVE_DEFAULTS["prompt_len"],
-               _SERVE_DEFAULTS["gen_tokens"])
+               _SERVE_DEFAULTS["gen_tokens"],
+               int(os.environ.get("PADDLE_TPU_SERVE_TP", "1") or 1))
         if key in measured:
             log(f"  serve resume: skipping measured megakernel={mk}")
             row = dict(measured[key])
@@ -913,6 +915,9 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         # token (int8-aware; the fused kernel's saving as a NUMBER)
         "decode_megakernel": stats["decode_megakernel"],
         "decode_hbm_bytes_per_tok": stats["decode_hbm_bytes_per_tok"],
+        # pod-scale serving (ISSUE 18): the tensor-parallel sweep axis
+        "tp": stats["tp"],
+        "serving_mesh": stats.get("serving_mesh"),
         "compile_ms_cold": stats["compile_ms_cold"],
         "xla_compiles_measured": snap.new_compiles,
         "host_syncs_measured": syncs,
@@ -955,6 +960,10 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         out["ok"] = True
         log(f"  serve smoke ok: {total_tokens} tokens, 0 compiles, "
             f"{syncs} syncs/{budget} budget")
+        # tp=2 CPU-mesh leg (ISSUE 18): subprocess, because the virtual
+        # device count can't change in an already-imported jax
+        _smoke_serve_tp()
+        out["serve_tp_smoke"] = True
     # executable observatory (ISSUE 15): analyze AFTER the measured
     # window + smoke assertions (the AOT re-lower is a compile the
     # 0-compile contract must not see) and attach the per-executable
@@ -1331,7 +1340,7 @@ def bench_multichip_child():
     for fn in (multichip.run_zero3_phase, multichip.run_1f1b_phase,
                multichip.run_moe_a2a_phase,
                multichip.run_elastic_restore_phase,
-               multichip.run_dcn_phase):
+               multichip.run_dcn_phase, multichip.run_serve_tp_phase):
         r = fn()
         phases.append(r)
         log(f"  multichip phase {r['name']} ok t={r['t_s']}s")
@@ -1344,6 +1353,44 @@ def bench_multichip_child():
         "phases": phases,
     }
     print(json.dumps(out))
+
+
+def bench_serve_tp_child():
+    """Child half of the --serve --smoke tp leg (runs with
+    JAX_PLATFORMS=cpu and 8 virtual host devices): tp=2 serving must be
+    token-identical to tp=1 on both KV layouts, recompile-free after
+    warmup, with submesh meta on the exec-registry entries.  Prints ONE
+    JSON line; any violated contract raises and exits non-zero."""
+    from paddle_tpu.testing import multichip
+    out = multichip.run_serve_tp_phase()
+    out["metric"] = "serve_tp_smoke"
+    out["ok"] = True
+    print(json.dumps(out))
+
+
+def _smoke_serve_tp(n_devices=8):
+    """tp=2 CPU-mesh leg of --serve --smoke (ISSUE 18): re-exec on a
+    virtual n-device mesh (same subprocess pattern + env scrub as
+    --multichip-smoke — jax is already imported here, so the device
+    count can only change in a child)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(kept)
+    for k in [k for k in env
+              if k.startswith(("AXON_", "PALLAS_AXON_", "TPU_"))]:
+        env.pop(k, None)
+    env.pop("PADDLE_TPU_SERVE_TP", None)   # the child builds its own mesh
+    rc = subprocess.call(
+        [sys.executable, "-u", os.path.abspath(__file__),
+         "--serve-tp-child"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    if rc != 0:
+        raise SystemExit(
+            f"serve --smoke: tp=2 CPU-mesh leg failed (exit {rc})")
+    log("  serve tp=2 smoke ok (parity + 0 compiles + submesh meta)")
 
 
 def bench_multichip_smoke(n_devices=8):
@@ -2053,6 +2100,10 @@ def main():
         else:
             # megakernel off/on enumerated (resume-aware), winner wins
             _serve_sweep()
+        return
+
+    if "--serve-tp-child" in sys.argv:
+        bench_serve_tp_child()
         return
 
     if "--multichip-child" in sys.argv:

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -231,7 +232,21 @@ class InferenceEngine:
         compile_counter.install()
 
         self.params, _ = functional_state(model)
+        # serving mesh (ISSUE 18): explicit arg, else PADDLE_TPU_SERVE_TP=N
+        # builds a {"dp": 1, "tp": N} mesh.  Every serving executable then
+        # compiles SPMD over it — weights column/row-split by the pspecs
+        # the training-side parallel layers already mark, KV heads over
+        # 'tp', dense batch slots over 'dp' — with no model-code changes:
+        # GSPMD follows the committed operand shardings.
+        if mesh is None:
+            env_tp = os.environ.get("PADDLE_TPU_SERVE_TP", "").strip()
+            if env_tp and int(env_tp) > 1:
+                from ..distributed.mesh import create_mesh
+                mesh = create_mesh({"dp": 1, "tp": int(env_tp)})
         self.mesh = mesh
+        self.tp_degree = int(mesh.shape["tp"]) \
+            if mesh is not None and "tp" in mesh.axis_names else 1
+        self._shard_warned = False
         if self.kv_layout == "paged":
             self._init_paged(cache_dtype, kv_block_size, kv_num_blocks,
                              prefix_cache)
@@ -241,8 +256,8 @@ class InferenceEngine:
                                              kv_dtype=self.kv_dtype)
             self._alloc = None
             self._prefix = None
-            if mesh is not None:
-                self._shard_over_mesh(mesh)
+        if mesh is not None:
+            self._shard_over_mesh(mesh)
 
         # CPU + persistent cache + donation = the PR 2 mis-alias hazard
         # (deserialized executables alias donated buffers wrongly on
@@ -272,9 +287,11 @@ class InferenceEngine:
 
         # speculative decoding (inference.spec_decode): a draft model +
         # K>0 replace the single-token decode step with a propose/verify
-        # tick committing ~K+1 tokens per host sync.  Greedy only — the
-        # acceptance rule is the temperature-0 rejection rule, so output
-        # is token-identical to the non-speculative rollout.
+        # tick committing ~K+1 tokens per host sync.  Greedy slots use
+        # the temperature-0 acceptance rule (token-identical to the
+        # non-speculative rollout); temperature>0 slots run the full
+        # rejection-sampling residual (ISSUE 18 satellite), so sampled
+        # traffic rides the spec path too.
         from .spec_decode import SpecDecoder, resolve_spec_k
         sk = resolve_spec_k(spec_k)
         self._spec = None
@@ -289,6 +306,16 @@ class InferenceEngine:
         self.spec_k = self._spec.k if self._spec else 0
 
         self._key = jax.random.PRNGKey(int(seed))
+        if self.mesh is not None:
+            # commit the sampling key to the mesh (replicated) at init:
+            # the steady-state key is a mesh-committed jit output, and a
+            # host-resident warmup key would recompile every key
+            # consumer (split/sample/decode) on the first real step —
+            # the jit cache keys on committed-vs-uncommitted shardings
+            try:
+                self._key = self._put(self.mesh, self._key, (None,))
+            except Exception as e:
+                self._shard_failed("rng_key", e)
 
         # scheduler state
         self._queue: deque = deque()
@@ -404,6 +431,7 @@ class InferenceEngine:
         if bs < 1:
             raise ValueError(f"kv_block_size must be >= 1, got {bs}")
         self.block_size = bs
+        self._cache_dtype = cache_dtype   # disagg worker pool mirrors it
         self.blocks_per_slot = blocks_for(self.max_seq_len, bs)
         usable = int(kv_num_blocks or
                      os.environ.get("PADDLE_TPU_KV_BLOCKS", 0)) or \
@@ -426,31 +454,111 @@ class InferenceEngine:
             if prefix_cache else None
 
     # ---- sharding -----------------------------------------------------
+    def _spec_for(self, mesh, arr, dims):
+        """NamedSharding for ``arr`` from a per-dimension axis-name
+        tuple.  A dimension degrades to replicated when the axis is
+        missing from the mesh, has extent 1, or does not divide the
+        array dimension (GSPMD would otherwise pad) — so every caller
+        can name its IDEAL layout and let the mesh decide."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        out = []
+        for d, ax in enumerate(dims):
+            ok = (isinstance(ax, str) and ax in mesh.axis_names
+                  and int(mesh.shape[ax]) > 1
+                  and arr.shape[d] % int(mesh.shape[ax]) == 0)
+            out.append(ax if ok else None)
+        return NamedSharding(mesh, P(*out))
+
+    def _put(self, mesh, arr, dims):
+        return jax.device_put(arr, self._spec_for(mesh, arr, dims))
+
+    def _shard_failed(self, what: str, err: Exception):
+        """A mis-sharded pod must read as DEGRADED, not silently
+        replicate (ISSUE 18 satellite): warn once per engine, count
+        every failure in ``engine_sharding_failures_total``."""
+        import warnings
+        _metrics.counter(
+            "engine_sharding_failures_total",
+            "serving-state placements that fell back to replicated"
+        ).inc()
+        if not self._shard_warned:
+            self._shard_warned = True
+            warnings.warn(
+                f"serving-mesh sharding failed for {what}: {err!r} — "
+                f"the engine continues with replicated state (slower, "
+                f"more HBM per device, never wrong)", RuntimeWarning,
+                stacklevel=3)
+
+    def _shard_params_over(self, mesh, params, module):
+        """Commit a functional_state params dict to ``mesh`` by the
+        pspecs the training-side parallel layers marked on their
+        parameters (ColumnParallelLinear W: P(None,'tp'),
+        RowParallelLinear W: P('tp',None), VocabParallelEmbedding:
+        P('tp',None)); unmarked parameters replicate.  Committed
+        weights are what makes every downstream jit compile SPMD —
+        GSPMD follows the operands, no model-code changes."""
+        marked = dict(module.named_parameters())
+        out = {}
+        for name, arr in params.items():
+            pspec = getattr(marked.get(name), "pspec", None)
+            dims = [None] * arr.ndim
+            if pspec is not None:
+                for d, ax in enumerate(tuple(pspec)[:arr.ndim]):
+                    dims[d] = ax
+            out[name] = self._put(mesh, arr, dims)
+        return out
+
+    def _shard_dense_cache_arrays(self, mesh, cache):
+        """StaticKVCache layout on the mesh: k/v [L, B, S, Hkv, D] —
+        batch slots over 'dp', KV heads over 'tp'; lengths follow the
+        slots.  Returns a new cache of the same type."""
+        scales = ()
+        if cache.quantized:
+            scales = (self._put(mesh, cache.k_scale,
+                                (None, "dp", None, "tp")),
+                      self._put(mesh, cache.v_scale,
+                                (None, "dp", None, "tp")))
+        return type(cache)(
+            self._put(mesh, cache.k, (None, "dp", None, "tp", None)),
+            self._put(mesh, cache.v, (None, "dp", None, "tp", None)),
+            self._put(mesh, cache.lengths, ("dp",)),
+            *scales)
+
+    def _shard_paged_cache_arrays(self, mesh, cache):
+        """Paged pool layout on the mesh: k/v [L, NB, bs, Hkv, D] —
+        KV heads over 'tp', block/position dims REPLICATED so host-side
+        allocation, the radix prefix cache and zero-recompile slot
+        churn never see the mesh (block tables stay plain host int32)."""
+        scales = ()
+        if cache.quantized:
+            scales = (self._put(mesh, cache.k_scale,
+                                (None, None, None, "tp")),
+                      self._put(mesh, cache.v_scale,
+                                (None, None, None, "tp")))
+        return type(cache)(
+            self._put(mesh, cache.k, (None, None, None, "tp", None)),
+            self._put(mesh, cache.v, (None, None, None, "tp", None)),
+            *scales)
+
     def _shard_over_mesh(self, mesh):
-        """Place the dense cache like a training activation: batch_slots
-        over 'dp', kv heads over 'tp' when those axes exist (best-effort
-        — a 1-device mesh or missing axes degrade to replicated).  The
-        paged pool stays replicated for now: its block dimension has no
-        stable owner under continuous reallocation."""
+        """Commit the engine's resident state (weights + KV cache) to
+        the serving mesh.  Failures route through _shard_failed
+        (warn-once + metric) instead of a silent pass: the engine still
+        serves correct tokens replicated, but the operator can see it."""
         try:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            names = mesh.axis_names
-            dp = "dp" if "dp" in names and mesh.shape["dp"] > 1 else None
-            tp = "tp" if "tp" in names and mesh.shape["tp"] > 1 else None
-            kv_spec = NamedSharding(mesh, P(None, dp, None, tp, None))
-            sc_spec = NamedSharding(mesh, P(None, dp, None, tp))
-            len_spec = NamedSharding(mesh, P(dp))
-            scales = (None, None)
-            if self.cache.quantized:
-                scales = (jax.device_put(self.cache.k_scale, sc_spec),
-                          jax.device_put(self.cache.v_scale, sc_spec))
-            self.cache = type(self.cache)(
-                jax.device_put(self.cache.k, kv_spec),
-                jax.device_put(self.cache.v, kv_spec),
-                jax.device_put(self.cache.lengths, len_spec),
-                *scales)
-        except Exception:  # sharding is an optimization, never fatal
-            pass
+            self.params = self._shard_params_over(mesh, self.params,
+                                                  self.model)
+        except Exception as e:
+            self._shard_failed("params", e)
+        try:
+            if self.kv_layout == "paged":
+                self.cache = self._shard_paged_cache_arrays(mesh,
+                                                            self.cache)
+            else:
+                self.cache = self._shard_dense_cache_arrays(mesh,
+                                                            self.cache)
+        except Exception as e:
+            self._shard_failed("kv_cache", e)
 
     # ---- compiled functions -------------------------------------------
     def _prefill_fn(self, params, cache, ids, slot, prompt_len):
@@ -517,9 +625,10 @@ class InferenceEngine:
                   "prefill_paged_ext": "prefill", "disagg": "prefill",
                   "disagg_ext": "prefill", "draft_prefill": "prefill",
                   "decode": "decode", "spec_tick": "spec_verify",
-                  "sample": "sample"}
+                  "sample": "sample", "handoff_gather": "handoff",
+                  "handoff_scatter": "handoff"}
 
-    def _register_exec(self, key, jitfn, args):
+    def _register_exec(self, key, jitfn, args, mesh=None):
         """Join the process exec registry at compile time (the first
         call of this key): shape structs are captured BEFORE the call
         runs, so donation never invalidates what analyze() re-lowers
@@ -529,9 +638,23 @@ class InferenceEngine:
         kind = self._EXEC_KIND.get(fam, str(fam))
         meta = {"kv_layout": self.kv_layout,
                 "kv_dtype": self.kv_dtype or "dense"}
+        # pod-scale serving (ISSUE 18): the entry records WHICH devices
+        # it compiled against and the tp degree, so the observatory can
+        # tell a tp-sharded decode from a single-chip one (and the
+        # disagg prefill submesh from the decode submesh)
+        tp = 1
+        if mesh is not None:
+            tp = int(dict(mesh.shape).get("tp", 1))
+            meta["tp"] = tp
+            meta["submesh"] = {
+                "shape": {ax: int(n) for ax, n in mesh.shape.items()},
+                "devices": [int(d.id) for d in
+                            np.asarray(mesh.devices).flat]}
         if kind == "decode":
             from ..ops.decode_megakernel import megakernel_enabled
-            if megakernel_enabled(self.model.cfg):
+            # the megakernel stands down under tp>1 (gpt._megakernel
+            # _active) — the registry must say what actually compiled
+            if megakernel_enabled(self.model.cfg) and tp == 1:
                 kind = "megakernel_decode"
                 meta["megakernel"] = True
             meta["batch_slots"] = self.batch_slots
@@ -554,25 +677,55 @@ class InferenceEngine:
             self._exec_component, key, kind, jitfn=jitfn, args=args,
             donate_argnums=donate, meta=meta)
 
-    def _timed_exec(self, kind, key, jitfn, *args):
+    _MESH_DEFAULT = object()   # sentinel: "use self.mesh"
+
+    def _timed_exec(self, kind, key, jitfn, *args, mesh=_MESH_DEFAULT):
         """_timed with observatory wiring: the jitted callable and its
         args are visible here, so the first call registers the
         executable and steady-state calls pair their wall time with the
-        registry entry (one dict lookup + two adds — zero syncs)."""
+        registry entry (one dict lookup + two adds — zero syncs).
+        ``mesh`` overrides the compile mesh for this key (the disagg
+        PrefillWorker traces against its OWN submesh); the default is
+        the engine's serving mesh."""
+        if mesh is self._MESH_DEFAULT:
+            mesh = self.mesh
         if key not in self._first_call_keys and _exec_registry.enabled():
-            self._register_exec(key, jitfn, args)
-        return self._timed(kind, key, lambda: jitfn(*args))
+            self._register_exec(key, jitfn, args, mesh=mesh)
+        return self._timed(kind, key, lambda: jitfn(*args), mesh=mesh)
 
-    def _timed(self, kind, key, fn):
+    def _timed(self, kind, key, fn, mesh=_MESH_DEFAULT):
+        if mesh is self._MESH_DEFAULT:
+            mesh = self.mesh
+        if mesh is not None and key not in self._first_call_keys:
+            # first call per key = the trace: publish the mesh on BOTH
+            # channels (ambient + compile) so trace-time decisions —
+            # _megakernel_active's tp gate, the decode kernels'
+            # shard_map wrapper — see the serving mesh.  Steady-state
+            # calls skip the guard entirely (zero per-tick overhead).
+            from ..distributed.mesh import compile_mesh_guard
+            with compile_mesh_guard(mesh):
+                return self._timed_inner(kind, key, fn)
+        return self._timed_inner(kind, key, fn)
+
+    # first-call traces are serialized PROCESS-WIDE: two replicas of
+    # the same model driven from different threads (the RPC fleet
+    # loadtest, a multi-replica router) would otherwise trace jax
+    # programs concurrently over the SHARED module tree and leak
+    # tracers into each other's traces.  Steady-state calls never take
+    # the lock — only the one cold call per executable key does.
+    _trace_lock = threading.RLock()
+
+    def _timed_inner(self, kind, key, fn):
         t0 = time.perf_counter()
         if key not in self._first_call_keys:
             # first call per executable = trace + compile/deserialize
             self._first_call_keys.add(key)
-            if self._suspend_cache_hits:
-                with compile_cache.suspend_cpu_cache_hits():
+            with self._trace_lock:
+                if self._suspend_cache_hits:
+                    with compile_cache.suspend_cpu_cache_hits():
+                        out = fn()
+                else:
                     out = fn()
-            else:
-                out = fn()
             dt = (time.perf_counter() - t0) * 1e3
             self._timings["compile_ms_cold"] += dt
             _exec_registry.registry().note_compile(
@@ -596,11 +749,6 @@ class InferenceEngine:
         timed_out, instead of holding a decode slot forever."""
         req = Request(prompt, max_new_tokens, eos_id, temperature, top_p,
                       deadline_s=deadline_s)
-        if self._spec is not None and req.temperature > 0:
-            raise ValueError(
-                "speculative decoding serves greedy requests only "
-                "(the acceptance rule is the temperature-0 rejection "
-                "rule); run a non-spec engine for sampled traffic")
         if req.prompt.size > self.buckets[-1]:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds the largest "
@@ -826,7 +974,7 @@ class InferenceEngine:
         return True
 
     def _paged_prefill(self, req: Request, cold_jit, ext_jit,
-                       key_prefix: str):
+                       key_prefix: str, domain=None):
         """The paged prefill body: match the radix cache, allocate
         blocks for the divergent suffix's bucket, prefill ONLY the
         suffix, then trim the bucket-padding blocks and adopt the
@@ -834,19 +982,22 @@ class InferenceEngine:
         with the slot-lifetime refcounts TAKEN (the caller installs the
         block table and finishes admission), or None when the pool
         cannot hold the request yet.  Parameterized over the compiled
-        executables so the in-engine admission path and the
-        disaggregated PrefillWorker (its own executables = its own
-        device group) share one implementation."""
+        executables AND the state ``domain`` (params / cache / block
+        allocator / radix cache / mesh) so the in-engine admission path
+        and the disaggregated PrefillWorker — which under disjoint
+        disaggregation owns a SEPARATE pool on its own device group —
+        share one implementation.  ``domain=None`` means self."""
+        dom = domain if domain is not None else self
         bs = self.block_size
         prompt = req.effective_prompt()
         pc_stats0 = None
-        if self._prefix is not None:
+        if dom._prefix is not None:
             # a blocked head-of-line request re-matches on every retry;
             # roll the hit counters back on failure so the reported hit
             # rate counts admissions, not retries
-            pc_stats0 = (self._prefix.queries, self._prefix.hit_queries,
-                         self._prefix.hit_blocks)
-            shared, prefix_len = self._prefix.match(prompt)
+            pc_stats0 = (dom._prefix.queries, dom._prefix.hit_queries,
+                         dom._prefix.hit_blocks)
+            shared, prefix_len = dom._prefix.match(prompt)
         else:
             shared, prefix_len = [], 0
         # the bucket-padded extent must fit BOTH the slot's block table
@@ -857,7 +1008,7 @@ class InferenceEngine:
         # shed cached prefix blocks (recompute those tokens) until it
         # does — prefix_len=0 always fits, because add_request already
         # guaranteed blocks_for(bucket_for(prompt)) <= capacity
-        fit = min(self.blocks_per_slot, self._alloc.capacity)
+        fit = min(self.blocks_per_slot, dom._alloc.capacity)
         shed = 0
         while shared and blocks_for(
                 prefix_len + self._bucket_for(prompt.size - prefix_len),
@@ -868,9 +1019,9 @@ class InferenceEngine:
         if shed and pc_stats0 is not None:
             # shed blocks were never reused — keep the hit counters
             # honest (a fully-shed match is not a hit at all)
-            self._prefix.hit_blocks -= shed
+            dom._prefix.hit_blocks -= shed
             if not shared:
-                self._prefix.hit_queries -= 1
+                dom._prefix.hit_queries -= 1
         suffix = prompt[prefix_len:]
         bucket = self._bucket_for(suffix.size)
         need_total = blocks_for(prefix_len + bucket, bs)
@@ -880,13 +1031,13 @@ class InferenceEngine:
         # (refcount 1) would otherwise be freed and re-handed out as
         # this same request's "fresh" suffix block — aliasing the block
         # table and corrupting the shared prefix KV
-        self._alloc.incref(shared)
-        new_blocks = self._alloc_blocks(need_total - len(shared))
+        dom._alloc.incref(shared)
+        new_blocks = dom._alloc_blocks(need_total - len(shared))
         if new_blocks is None:
-            self._alloc.decref(shared)
+            dom._alloc.decref(shared)
             if pc_stats0 is not None:
-                (self._prefix.queries, self._prefix.hit_queries,
-                 self._prefix.hit_blocks) = pc_stats0
+                (dom._prefix.queries, dom._prefix.hit_queries,
+                 dom._prefix.hit_blocks) = pc_stats0
             return None                       # stay queued; retry later
         blocks = list(shared) + new_blocks
         req.t_admit = time.perf_counter()
@@ -901,30 +1052,31 @@ class InferenceEngine:
         if prefix_len == 0:
             logits, cache = self._timed_exec(
                 "prefill_ms", (key_prefix, bucket), cold_jit,
-                self.params, self.cache, jnp.asarray(ids),
-                jnp.asarray(row), np.int32(suffix.size))
+                dom.params, dom.cache, jnp.asarray(ids),
+                jnp.asarray(row), np.int32(suffix.size),
+                mesh=dom.mesh)
         else:
             logits, cache = self._timed_exec(
                 "prefill_ms", (key_prefix + "_ext", bucket), ext_jit,
-                self.params, self.cache, jnp.asarray(ids),
+                dom.params, dom.cache, jnp.asarray(ids),
                 jnp.asarray(row), np.int32(prefix_len),
-                np.int32(suffix.size))
-        self.cache = cache
+                np.int32(suffix.size), mesh=dom.mesh)
+        dom.cache = cache
 
         # trim: blocks past the REAL prompt extent only ever held bucket
         # padding — return them to the pool immediately
         plen = int(prefix_len + suffix.size)          # == prompt.size
         keep = blocks_for(plen, bs)
         if len(blocks) > keep:
-            self._alloc.decref(blocks[keep:])
+            dom._alloc.decref(blocks[keep:])
             blocks = blocks[:keep]
         # adopt the prompt's full blocks into the radix tree so the NEXT
         # request sharing this prefix skips its prefill
-        if self._prefix is not None:
+        if dom._prefix is not None:
             n_full = prompt.size // bs
             if n_full:
-                self._prefix.insert(prompt[:n_full * bs],
-                                    blocks[:n_full])
+                dom._prefix.insert(prompt[:n_full * bs],
+                                   blocks[:n_full])
         return blocks, plen, logits
 
     def admit_handoff(self, req: Request, slot: int, blocks, logits):
@@ -1435,9 +1587,17 @@ class InferenceEngine:
             jnp.zeros(self.batch_slots, jnp.int32), self._key,
             jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         # drop the warmup garbage: zero every slot's length (host-side
-        # constant, so no extra executable rides the hot path)
-        self.cache = type(cache)(cache.k, cache.v,
-                                 jnp.zeros((self.batch_slots,), jnp.int32),
+        # constant, so no extra executable rides the hot path).  On a
+        # serving mesh the zeros are COMMITTED like the originals —
+        # an uncommitted lengths operand would recompile the first
+        # real prefill (jit keys on committed-vs-uncommitted shardings)
+        zeros = jnp.zeros((self.batch_slots,), jnp.int32)
+        if self.mesh is not None:
+            try:
+                zeros = self._put(self.mesh, zeros, ("dp",))
+            except Exception as e:
+                self._shard_failed("warmup_lengths", e)
+        self.cache = type(cache)(cache.k, cache.v, zeros,
                                  cache.k_scale, cache.v_scale)
         return self
 
@@ -1494,21 +1654,30 @@ class InferenceEngine:
         step streams the parameters once (amortized over the
         batch_slots tokens it produces) plus each slot's full KV extent
         — int8-aware, counting the 8-bit values AND the f32 scale
-        planes the kernels stream alongside them."""
+        planes the kernels stream alongside them.  Under a tp-sharded
+        serving mesh the number is PER SHARD (ISSUE 18): each device
+        streams its weight shard and its slice of the KV heads — the
+        whole point of tensor-parallel decode is this denominator."""
+        tp = max(self.tp_degree, 1)
         pbytes = 0
         for leaf in jax.tree_util.tree_leaves(self.params):
             pbytes += int(np.prod(leaf.shape)) * \
                 jnp.dtype(leaf.dtype).itemsize
+        pbytes //= tp
         cfg = self.model.cfg
+        # KV heads split over tp only when they divide evenly (the
+        # sharding helpers replicate otherwise — mirror that here)
+        hkv = cfg.num_kv_heads // tp if cfg.num_kv_heads % tp == 0 \
+            else cfg.num_kv_heads
         kv_item = jnp.dtype(self.cache.k.dtype).itemsize
         if self.kv_layout == "paged":
             per_slot_pos = self.blocks_per_slot * self.block_size
         else:
             per_slot_pos = self.max_seq_len
-        kv = (2 * cfg.num_layers * per_slot_pos * cfg.num_kv_heads *
+        kv = (2 * cfg.num_layers * per_slot_pos * hkv *
               cfg.head_dim * kv_item)
         if self.cache.quantized:
-            kv += 2 * cfg.num_layers * per_slot_pos * cfg.num_kv_heads * 4
+            kv += 2 * cfg.num_layers * per_slot_pos * hkv * 4
         return int(pbytes / self.batch_slots + kv)
 
     @property
@@ -1536,8 +1705,17 @@ class InferenceEngine:
         s["donate"] = self._donate
         s["kv_layout"] = self.kv_layout
         s["kv_dtype"] = self.kv_dtype or "dense"
+        # pod-scale serving (ISSUE 18): tp degree + mesh layout ride
+        # every stats snapshot (and through it, bench rows + loadgen
+        # reports); the megakernel flag reports what actually runs —
+        # it stands down under tp>1 (see gpt._megakernel_active)
+        s["tp"] = self.tp_degree
+        if self.mesh is not None:
+            s["serving_mesh"] = {str(ax): int(n)
+                                 for ax, n in self.mesh.shape.items()}
         from ..ops.decode_megakernel import megakernel_enabled
-        s["decode_megakernel"] = megakernel_enabled(self.model.cfg)
+        s["decode_megakernel"] = (megakernel_enabled(self.model.cfg)
+                                  and self.tp_degree == 1)
         s["decode_hbm_bytes_per_tok"] = self._decode_hbm_bytes_per_tok()
         if self._spec is not None:
             s["spec_k"] = self._spec.k

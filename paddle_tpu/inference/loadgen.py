@@ -270,7 +270,8 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
                        workload: Optional[MultiTenantWorkload] = None,
                        seed: int = 0, eos_id: Optional[int] = None,
                        deadline_s: Optional[float] = None,
-                       slo_monitor: Optional[SLOMonitor] = None) -> dict:
+                       slo_monitor: Optional[SLOMonitor] = None,
+                       rpc: bool = False) -> dict:
     """Open-loop Poisson load test against a ROUTED fleet (a
     ``router.Router`` over warmed replicas) — the multi-replica twin of
     :func:`run_loadtest`.  Requests arrive on the Poisson clock, the
@@ -290,7 +291,33 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     deployment behaves and what makes routing quality visible in the
     TTFT tail.  Engines stay single-threaded internally (one driver
     thread each; the main thread only enqueues and reads finished
-    records)."""
+    records).
+
+    ``rpc=True`` (ISSUE 18 satellite) interposes the socket transport:
+    each replica is wrapped in a ``ReplicaRPCServer``, a fresh Router
+    over ``RPCReplicaProxy`` clients re-routes the same plan, and
+    every placement, summary scrape and engine step crosses the
+    length-prefixed JSON protocol — the wire contract replicas in
+    separate processes would speak."""
+    if rpc:
+        from .router import ReplicaRPCServer, RPCReplicaProxy
+        from .router import Router as _Router
+        servers = [ReplicaRPCServer(r).start() for r in router.replicas]
+        proxies = [RPCReplicaProxy(s.address) for s in servers]
+        rpc_router = _Router(proxies, policy=router.policy,
+                             max_load_gap=router.max_load_gap)
+        try:
+            report = run_fleet_loadtest(
+                rpc_router, num_requests, rate_rps, workload=workload,
+                seed=seed, eos_id=eos_id, deadline_s=deadline_s,
+                slo_monitor=slo_monitor)
+        finally:
+            for p in proxies:
+                p.close()
+            for s in servers:
+                s.stop()
+        report["rpc"] = True
+        return report
     replicas = router.replicas
     workload = workload or MultiTenantWorkload(
         getattr(replicas[0].model.cfg, "vocab_size", 1 << 15), seed=seed)

@@ -24,6 +24,11 @@ fleet smoke beats.
 from __future__ import annotations
 
 import itertools
+import json
+import socket
+import struct
+import threading
+from types import SimpleNamespace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,7 +36,7 @@ import numpy as np
 from ..observability import metrics as _metrics
 from .prefix_cache import fingerprint_chain, score_overlap
 
-__all__ = ["Router"]
+__all__ = ["Router", "ReplicaRPCServer", "RPCReplicaProxy"]
 
 _POLICIES = ("prefix", "least_loaded", "round_robin")
 
@@ -211,3 +216,273 @@ class Router:
             "router_prefix_blocks": self.prefix_blocks_routed,
             "replica_loads": [self._load(r) for r in self.replicas],
         }
+
+
+# ---------------------------------------------------------------------------
+# Cross-process replica transport (ISSUE 18 satellite, ROADMAP 2c).
+#
+# The router only ever needs two calls off a replica — ``summary()``
+# (the radix-cache fingerprint digest) and ``add_request`` — so the
+# transport is deliberately tiny: length-prefixed JSON frames over a
+# TCP socket (4-byte big-endian length + UTF-8 JSON body), one
+# persistent connection per proxy, request/response lockstep.  The
+# server wraps a live engine (or DisaggServingEngine) and serializes
+# every engine touch under one lock; the proxy duck-types the replica
+# surface the Router, FleetAggregator and fleet load harness read
+# (``_queue``/``num_active``/``blocks_in_use``/``_timings``/
+# ``request_stats``/``results``) from cached scrape snapshots that
+# refresh on every ``step_or_raise``/``refresh_stats`` round trip.
+#
+# Same-host scope by design: radix fingerprints are Python ``hash()``
+# values, so cross-PROCESS summary agreement needs a pinned
+# PYTHONHASHSEED — cross-host hardening is the ROADMAP remainder.
+# ---------------------------------------------------------------------------
+
+def _json_default(o):
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+def _send_frame(sock, obj) -> None:
+    data = json.dumps(obj, default=_json_default).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock) -> Optional[dict]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    body = _recv_exact(sock, n)
+    return None if body is None else json.loads(body.decode("utf-8"))
+
+
+class ReplicaRPCServer:
+    """Expose one replica over the socket protocol.  ``port=0`` binds
+    an ephemeral port; ``.address`` is the ``(host, port)`` a proxy
+    connects to.  ``lock`` lets a caller share its own exclusion (the
+    fleet harness'); default is a private lock — either way every
+    engine call runs under it, so concurrent proxy connections are
+    safe."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
+                 lock: Optional[threading.Lock] = None):
+        self.replica = replica
+        self._lock = lock if lock is not None else threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ReplicaRPCServer":
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_frame(conn)
+                except OSError:
+                    break
+                if msg is None:
+                    break
+                try:
+                    resp = {"ok": True, "r": self._dispatch(msg)}
+                except Exception as e:
+                    resp = {"ok": False,
+                            "e": f"{type(e).__name__}: {e}"}
+                try:
+                    _send_frame(conn, resp)
+                except OSError:
+                    break
+
+    def _snapshot(self) -> dict:
+        """The proxy-side replica surface in one JSON-safe dict.
+        Caller holds the lock."""
+        r = self.replica
+        return {
+            "timings": dict(r._timings),
+            "request_stats": {int(k): dict(v)
+                              for k, v in r.request_stats.items()},
+            "results": {int(k): np.asarray(v).tolist()
+                        for k, v in r.results.items()},
+            "queue_len": len(r._queue),
+            "handoffs": len(getattr(r, "_handoffs", ())),
+            "num_active": int(r.num_active),
+            "blocks_in_use": r.blocks_in_use,
+            "has_work": bool(r.has_work),
+        }
+
+    def _dispatch(self, msg: dict):
+        m = msg.get("m")
+        r = self.replica
+        if m == "ping":
+            cfg = getattr(r.model, "cfg", None)
+            return {"vocab_size": int(getattr(cfg, "vocab_size",
+                                              1 << 15)),
+                    "batch_slots": int(getattr(r, "batch_slots", 1)),
+                    "request_stats_cap": int(getattr(
+                        r, "_request_stats_cap", 4096))}
+        if m == "summary":
+            with self._lock:
+                summ = r.prefix_summary()
+            if summ is None:
+                return None
+            out = dict(summ)
+            out["fingerprints"] = list(summ["fingerprints"])
+            return out
+        if m == "add_request":
+            prompt = np.asarray(msg["prompt"], np.int32)
+            with self._lock:
+                return int(r.add_request(prompt, **msg.get("kw", {})))
+        if m == "load":
+            with self._lock:
+                return {"queue_len": len(r._queue),
+                        "handoffs": len(getattr(r, "_handoffs", ())),
+                        "num_active": int(r.num_active),
+                        "blocks_in_use": r.blocks_in_use,
+                        "has_work": bool(r.has_work)}
+        if m == "step":
+            with self._lock:
+                produced = r.step_or_raise()
+                return {"produced": int(produced),
+                        "snap": self._snapshot()}
+        if m == "scrape":
+            with self._lock:
+                return self._snapshot()
+        if m == "stop":
+            self.stop()
+            return True
+        raise ValueError(f"unknown RPC method {m!r}")
+
+
+class RPCReplicaProxy:
+    """Client half: duck-types the replica surface off cached scrape
+    snapshots.  ``summary()``/``add_request`` are live round trips (the
+    two calls the Router needs); ``step_or_raise`` drives the remote
+    engine and refreshes the snapshot in the same round trip, so the
+    fleet harness' per-replica driver threads keep the cached view
+    current; ``refresh_stats()`` is the explicit pull FleetAggregator
+    uses between steps."""
+
+    def __init__(self, address, connect_timeout: float = 5.0):
+        self.address = tuple(address)
+        self._sock = socket.create_connection(self.address,
+                                              timeout=connect_timeout)
+        # steps may sit behind a cold compile — no read deadline
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._prefix = None               # prefix state lives remotely
+        self._timings: dict = {}
+        self.request_stats: Dict[int, dict] = {}
+        self.results: Dict[int, np.ndarray] = {}
+        self._queue: tuple = ()
+        self._handoffs: tuple = ()
+        self.num_active = 0
+        self.blocks_in_use = None
+        self._has_work = False
+        info = self._call("ping")
+        self.batch_slots = int(info["batch_slots"])
+        self._request_stats_cap = int(info["request_stats_cap"])
+        self.model = SimpleNamespace(cfg=SimpleNamespace(
+            vocab_size=int(info["vocab_size"])))
+        self.refresh_stats()
+
+    def _call(self, method: str, **fields):
+        msg = {"m": method}
+        msg.update(fields)
+        with self._lock:
+            _send_frame(self._sock, msg)
+            resp = _recv_frame(self._sock)
+        if resp is None:
+            raise ConnectionError("replica RPC connection closed")
+        if not resp.get("ok"):
+            raise RuntimeError(f"replica RPC failed: {resp.get('e')}")
+        return resp.get("r")
+
+    def _apply(self, snap: dict) -> None:
+        self._timings = snap["timings"]
+        self.request_stats.update(
+            {int(k): v for k, v in snap["request_stats"].items()})
+        self.results.update(
+            {int(k): np.asarray(v, np.int32)
+             for k, v in snap["results"].items()})
+        self._apply_load(snap)
+
+    def _apply_load(self, snap: dict) -> None:
+        self._queue = (None,) * int(snap["queue_len"])
+        self._handoffs = (None,) * int(snap.get("handoffs", 0))
+        self.num_active = int(snap["num_active"])
+        self.blocks_in_use = snap["blocks_in_use"]
+        self._has_work = bool(snap["has_work"])
+
+    def refresh_stats(self) -> None:
+        self._apply(self._call("scrape"))
+
+    def prefix_summary(self) -> Optional[dict]:
+        summ = self._call("summary")
+        if summ is None:
+            return None
+        summ["fingerprints"] = set(summ["fingerprints"])
+        return summ
+
+    def add_request(self, prompt, **kw) -> int:
+        kw = {k: v for k, v in kw.items() if v is not None}
+        return int(self._call(
+            "add_request", prompt=np.asarray(prompt).tolist(), kw=kw))
+
+    @property
+    def has_work(self) -> bool:
+        self._apply_load(self._call("load"))
+        return self._has_work
+
+    def step_or_raise(self) -> int:
+        out = self._call("step")
+        self._apply(out["snap"])
+        return int(out["produced"])
+
+    def step(self) -> int:
+        return self.step_or_raise()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass

@@ -6,13 +6,19 @@ the whole model + KV cache through the chip to emit ONE token.  A small
 draft model can propose K tokens cheaply; the target model then scores
 all K+1 positions in ONE windowed forward (the multi-token variant of
 ``ops.decode_attention`` — same bytes streamed as a single decode step)
-and keeps the longest prefix of proposals that match its own greedy
-choices, plus one bonus token from its own logits.  Greedy acceptance is
-the standard rejection rule at temperature 0, so the emitted stream is
-TOKEN-IDENTICAL to the target-only rollout — speculation changes the
-schedule, never the text.  With an agreeable draft, each tick emits
-~K+1 tokens for one target pass + one host sync, and the decode loop's
-HBM bytes per emitted token drop proportionally.
+and keeps the longest prefix of accepted proposals plus one bonus token
+from its own distribution.  Temperature-0 slots use the greedy rule
+(match the target's argmax), so the emitted stream is TOKEN-IDENTICAL
+to the target-only rollout — speculation changes the schedule, never
+the text.  Temperature>0 slots run the FULL rejection-sampling rule
+(Leviathan Alg. 1): proposal ``d_i ~ q_i`` accepts with probability
+``min(1, p_i(d_i)/q_i(d_i))`` over the WARPED (temperature/top-k/top-p)
+distributions, and the first rejected position resamples from the
+residual ``norm(max(p - q, 0))`` — in-graph, fixed shapes, so the
+committed stream is a faithful sample from the target distribution and
+a seeded engine replays the same stream.  With an agreeable draft, each
+tick emits ~K+1 tokens for one target pass + one host sync, and the
+decode loop's HBM bytes per emitted token drop proportionally.
 
 Mechanics per tick (ONE fixed-shape jitted call — the zero-recompile
 contract of the engine survives):
@@ -38,9 +44,11 @@ contract of the engine survives):
 The draft always rides a dense StaticKVCache (it is small; block
 accounting for it would buy nothing); the TARGET cache is whatever the
 engine runs — dense or paged, fp or int8 — which is the matrix the
-tests pin down.  ``PADDLE_TPU_SPEC_K`` arms it engine-wide; greedy
-sampling only (the rejection rule below IS temperature 0 — sampled
-speculation needs the full rejection-sampling residual, a follow-up).
+tests pin down.  ``PADDLE_TPU_SPEC_K`` arms it engine-wide, for greedy
+AND sampled traffic (ISSUE 18: temperature>0 requests no longer bypass
+the spec path).  Under a tp serving mesh the draft's params and cache
+shard exactly like the target's (engine._shard_over_mesh helpers), so
+the tick executable compiles SPMD end to end.
 
 Capacity caveat: a tick writes its whole K+1 window before knowing how
 much commits, so a stream retires once ``len + K + 1`` would pass
@@ -110,6 +118,17 @@ class SpecDecoder:
         # proposals), so the host never tracks draft state
         self.draft_cache = draft_model.init_kv_cache(
             engine.batch_slots, engine.max_seq_len)
+        # pod-scale serving (ISSUE 18): the draft rides the SAME mesh —
+        # params by the parallel-layer pspecs, dense cache slots/heads
+        # over dp/tp — so the whole tick compiles SPMD
+        if engine.mesh is not None:
+            try:
+                self.draft_params = engine._shard_params_over(
+                    engine.mesh, self.draft_params, draft_model)
+                self.draft_cache = engine._shard_dense_cache_arrays(
+                    engine.mesh, self.draft_cache)
+            except Exception as e:
+                engine._shard_failed("spec_draft", e)
         # per-slot catch-up window: committed tokens the draft has not
         # seen yet (1 after a fresh admission — the first sampled
         # token; up to 2 mid-stream)
@@ -130,12 +149,49 @@ class SpecDecoder:
         return functional_apply(self.draft, "prefill", params, ids,
                                 cache, slot, prompt_len)
 
-    def _draft_propose(self, d_params, d_cache, last_win, nprev, active):
-        """Catch-up window + K-1 single-token steps -> K greedy draft
-        proposals.  Returns (drafts [B, K], d_cache) with the draft
-        cache advanced past everything it processed (catch-up tokens
-        AND proposals — the tick rolls rejected proposals back)."""
-        b = last_win.shape[0]
+    def _warped_probs(self, logits, temps, top_ps):
+        """The engine sampler's warping (temperature + static top-k +
+        per-slot top-p) as a PROBABILITY vector — the p and q the
+        rejection rule compares must be the distributions actually
+        sampled from, not the raw softmaxes.  logits [N, V] f32;
+        returns [N, V] probs (rows with temp<=0 are still valid — they
+        are simply never read, greedy rows use argmax)."""
+        eng = self.engine
+        logits = logits.astype(jnp.float32)
+        v = logits.shape[-1]
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if eng.top_k and eng.top_k < v:
+            kth = jax.lax.top_k(scaled, eng.top_k)[0][:, -1:]
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        s_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        probs = jax.nn.softmax(s_logits, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        s_logits = jnp.where(csum - probs < top_ps[:, None],
+                             s_logits, -1e30)
+        s_probs = jax.nn.softmax(s_logits, axis=-1)
+        inv = jnp.argsort(sort_idx, axis=-1)   # unsort to token order
+        return jnp.take_along_axis(s_probs, inv, axis=-1)
+
+    def _propose_from(self, logits, key, temps, top_ps):
+        """One proposal from the draft's logit row: greedy slots take
+        argmax, sampled slots draw from the warped distribution q.
+        Returns (token [B], q [B, V])."""
+        q = self._warped_probs(logits, temps, top_ps)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            key, jnp.log(q + 1e-38), axis=-1).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy), q
+
+    def _draft_propose(self, d_params, d_cache, last_win, nprev, active,
+                       key, temps, top_ps):
+        """Catch-up window + K-1 single-token steps -> K draft
+        proposals (greedy slots: argmax; sampled slots: drawn from the
+        warped draft distribution).  Returns (drafts [B, K],
+        q [B, K, V] — the proposal distributions the accept rule
+        needs — d_cache, key) with the draft cache advanced past
+        everything it processed (catch-up tokens AND proposals — the
+        tick rolls rejected proposals back)."""
         logits_d, d_cache = functional_apply(
             self.draft, "verify_step", d_params, last_win, d_cache)
         # advance the draft past the nprev real catch-up tokens
@@ -146,27 +202,80 @@ class SpecDecoder:
         idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
         last_logits = jnp.take_along_axis(
             logits_d, idx[:, None, None], axis=1)[:, 0]    # [B, V]
-        d_prev = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        drafts = [d_prev]
+        key, sub = jax.random.split(key)
+        d_prev, q0 = self._propose_from(last_logits, sub, temps, top_ps)
+        drafts, qs = [d_prev], [q0]
         for _ in range(self.k - 1):
             lg, d_cache = functional_apply(
                 self.draft, "decode_step", d_params, d_prev, d_cache,
                 active)
-            d_prev = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            d_prev, qi = self._propose_from(lg, sub, temps, top_ps)
             drafts.append(d_prev)
-        return jnp.stack(drafts, axis=1), d_cache          # [B, K]
+            qs.append(qi)
+        return (jnp.stack(drafts, axis=1), jnp.stack(qs, axis=1),
+                d_cache, key)                   # [B, K], [B, K, V]
 
-    def _accept(self, drafts, logits_t, active):
-        """The greedy rejection rule.  logits_t [B, K+1, V] — target
-        logits over [last_committed, d_1..d_K].  Returns
-        (g [B, K+1] — the target-greedy tokens, n_emit [B] — committed
-        count = accepted drafts + 1 bonus, masked by active)."""
+    def _accept(self, drafts, q, logits_t, active, key, temps, top_ps):
+        """The rejection rule, both temperatures in one fixed-shape
+        graph.  logits_t [B, K+1, V] — target logits over
+        [last_committed, d_1..d_K]; q [B, K, V] — the warped draft
+        distributions the proposals were drawn from.
+
+        Greedy rows (temp<=0): accept while ``d_i == argmax p_i`` —
+        the temperature-0 limit of the rule below, kept as the exact
+        argmax comparison so greedy streams stay bit-identical to the
+        non-speculative engine.
+
+        Sampled rows: position i accepts iff ``u_i * q_i(d_i) <
+        p_i(d_i)`` (u ~ U[0,1); the standard min(1, p/q) acceptance),
+        and the commit stream is the accepted prefix plus one token
+        from the residual ``norm(max(p - q, 0))`` at the first
+        rejected position — with ``q_K ≡ 0`` so a fully-accepted
+        window's bonus is a plain sample from ``p_K``.  The residual
+        is computed at EVERY position (fixed shapes) and gathered at
+        ``n_acc``; a numerically zero residual (p == q) falls back to
+        sampling p itself, which is the correct limit.
+
+        Returns (toks [B, K+1] — the committed stream per row,
+        n_acc [B], n_emit [B] = (n_acc+1)·active, key)."""
+        b, kp1, v = logits_t.shape
         g = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
         match = (drafts == g[:, :self.k]).astype(jnp.int32)
-        acc = jnp.cumprod(match, axis=1)       # accepted-prefix mask
-        n_acc = jnp.sum(acc, axis=1)
+        n_acc_g = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        # warped target probs p over all K+1 positions (row-broadcast
+        # of the per-slot knobs)
+        t_rep = jnp.repeat(temps, kp1)
+        tp_rep = jnp.repeat(top_ps, kp1)
+        p = self._warped_probs(logits_t.reshape(b * kp1, v),
+                               t_rep, tp_rep).reshape(b, kp1, v)
+        key, k_u, k_r = jax.random.split(key, 3)
+        u = jax.random.uniform(k_u, (b, self.k))
+        p_d = jnp.take_along_axis(
+            p[:, :self.k], drafts[:, :, None], axis=2)[:, :, 0]
+        q_d = jnp.take_along_axis(q, drafts[:, :, None], axis=2)[:, :, 0]
+        acc_s = (u * q_d < p_d).astype(jnp.int32)
+        n_acc_s = jnp.sum(jnp.cumprod(acc_s, axis=1), axis=1)
+        q_pad = jnp.concatenate([q, jnp.zeros((b, 1, v), q.dtype)],
+                                axis=1)
+        res = jnp.maximum(p - q_pad, 0.0)
+        rsum = jnp.sum(res, axis=-1, keepdims=True)
+        res = jnp.where(rsum > 0, res / jnp.maximum(rsum, 1e-38), p)
+        r_tok = jax.random.categorical(
+            k_r, jnp.log(res.reshape(b * kp1, v) + 1e-38),
+            axis=-1).reshape(b, kp1).astype(jnp.int32)
+        # sampled-row commit stream: accepted drafts, then the residual
+        # draw at n_acc (positions past it are never read by the host)
+        pos = jnp.arange(kp1)[None, :]
+        bonus = jnp.take_along_axis(r_tok, n_acc_s[:, None], axis=1)
+        d_pad = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        toks_s = jnp.where(pos < n_acc_s[:, None], d_pad, bonus)
+        sampled_row = (temps > 0)
+        toks = jnp.where(sampled_row[:, None], toks_s, g)
+        n_acc = jnp.where(sampled_row, n_acc_s, n_acc_g)
         n_emit = (n_acc + 1) * active.astype(jnp.int32)
-        return g, n_acc, n_emit
+        return toks, n_acc, n_emit, key
 
     def _draft_rollback(self, d_cache, n_acc, active):
         """Proposals past the accepted prefix are NOT part of the
@@ -181,43 +290,48 @@ class SpecDecoder:
                              d_cache.k_scale, d_cache.v_scale)
 
     def _tick_dense_fn(self, t_params, d_params, t_cache, d_cache,
-                       last_win, nprev, active):
+                       last_win, nprev, active, key, temps, top_ps):
         """One dense-target spec tick; returns (out [B, K+2] int32 —
-        the K+1 target-greedy tokens + the committed count, ONE host
-        readback — t_cache, d_cache)."""
-        drafts, d_cache = self._draft_propose(d_params, d_cache,
-                                              last_win, nprev, active)
+        the K+1 committed-stream tokens + the committed count, ONE host
+        readback — key, t_cache, d_cache)."""
+        drafts, q, d_cache, key = self._draft_propose(
+            d_params, d_cache, last_win, nprev, active, key, temps,
+            top_ps)
         idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
         t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
         window = jnp.concatenate([t0, drafts], axis=1)     # [B, K+1]
         logits_t, t_cache = functional_apply(
             self.engine.model, "verify_step", t_params, window, t_cache)
-        g, n_acc, n_emit = self._accept(drafts, logits_t, active)
+        toks, n_acc, n_emit, key = self._accept(
+            drafts, q, logits_t, active, key, temps, top_ps)
         t_cache = StaticKVCache(
             t_cache.k, t_cache.v,
             jnp.minimum(t_cache.lengths + n_emit, t_cache.capacity),
             t_cache.k_scale, t_cache.v_scale)
         d_cache = self._draft_rollback(d_cache, n_acc, active)
-        out = jnp.concatenate([g, n_emit[:, None]], axis=1)
-        return out, t_cache, d_cache
+        out = jnp.concatenate([toks, n_emit[:, None]], axis=1)
+        return out, key, t_cache, d_cache
 
     def _tick_paged_fn(self, t_params, d_params, t_cache, d_cache,
-                       last_win, nprev, active, tables, t_lens):
+                       last_win, nprev, active, tables, t_lens, key,
+                       temps, top_ps):
         """Paged-target spec tick: identical flow with the target's
         window scattered through the block tables; target lengths are
         HOST state (the scheduler advances them from the readback)."""
-        drafts, d_cache = self._draft_propose(d_params, d_cache,
-                                              last_win, nprev, active)
+        drafts, q, d_cache, key = self._draft_propose(
+            d_params, d_cache, last_win, nprev, active, key, temps,
+            top_ps)
         idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
         t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
         window = jnp.concatenate([t0, drafts], axis=1)
         logits_t, t_cache = functional_apply(
             self.engine.model, "verify_step_paged", t_params, window,
             t_cache, tables, t_lens)
-        g, n_acc, n_emit = self._accept(drafts, logits_t, active)
+        toks, n_acc, n_emit, key = self._accept(
+            drafts, q, logits_t, active, key, temps, top_ps)
         d_cache = self._draft_rollback(d_cache, n_acc, active)
-        out = jnp.concatenate([g, n_emit[:, None]], axis=1)
-        return out, t_cache, d_cache
+        out = jnp.concatenate([toks, n_emit[:, None]], axis=1)
+        return out, key, t_cache, d_cache
 
     # ---- host-side hooks the engine calls -----------------------------
     def on_admit(self, req, slot: int, first_tok: int):
@@ -260,23 +374,31 @@ class SpecDecoder:
 
     def tick(self, active: np.ndarray):
         """Run one spec tick over the current slots; returns the host
-        readback ``out [B, K+2]`` (K+1 target-greedy tokens + committed
-        count per slot)."""
+        readback ``out [B, K+2]`` (K+1 committed-stream tokens +
+        committed count per slot).  The engine's PRNG key threads
+        through the tick (sampled acceptance + residual draws) and
+        advances exactly once per tick, so a seeded engine replays the
+        same stream."""
         eng = self.engine
         if eng.kv_layout == "paged":
-            out, t_cache, d_cache = eng._timed_exec(
+            out, key, t_cache, d_cache = eng._timed_exec(
                 "decode_ms", ("spec_tick", 0), self._tick_paged_jit,
                 eng.params, self.draft_params, eng.cache,
                 self.draft_cache, jnp.asarray(self.win),
                 jnp.asarray(self.nprev), jnp.asarray(active),
                 jnp.asarray(eng._tables),
-                jnp.asarray(eng._slot_len.astype(np.int32)))
+                jnp.asarray(eng._slot_len.astype(np.int32)),
+                eng._key, jnp.asarray(eng._temps),
+                jnp.asarray(eng._top_ps))
         else:
-            out, t_cache, d_cache = eng._timed_exec(
+            out, key, t_cache, d_cache = eng._timed_exec(
                 "decode_ms", ("spec_tick", 0), self._tick_dense_jit,
                 eng.params, self.draft_params, eng.cache,
                 self.draft_cache, jnp.asarray(self.win),
-                jnp.asarray(self.nprev), jnp.asarray(active))
+                jnp.asarray(self.nprev), jnp.asarray(active),
+                eng._key, jnp.asarray(eng._temps),
+                jnp.asarray(eng._top_ps))
+        eng._key = key
         eng.cache = t_cache
         self.draft_cache = d_cache
         return out

@@ -349,7 +349,8 @@ class ExecRegistry:
             e.analysis_error = e.analysis_error or "owner released"
             return False
         try:
-            compiled = jitfn.lower(*e._arg_shapes).compile()
+            compiled = jitfn.lower(
+                *self._normalized_arg_shapes(e)).compile()
         except Exception as exc:
             self._m_failures.labels(stage="lower_compile").inc()
             e.analysis_error = (f"lower_compile: {type(exc).__name__}: "
@@ -373,12 +374,63 @@ class ExecRegistry:
             pass
         e.analysis = {"cost": cost, "memory": mem,
                       "out_shardings": out_sh}
+        # pod-scale serving (ISSUE 18): an entry that compiled against a
+        # multi-device (sub)mesh folds in its collective traffic, split
+        # per MESH AXIS — the tp/dp attribution bench --serve rows and
+        # the doctor read.  Diagnostics only: any failure leaves the
+        # cost/memory analysis intact and counts in the failure metric.
+        shape = ((e.meta or {}).get("submesh") or {}).get("shape") or {}
+        if any(int(n) > 1 for n in shape.values()):
+            try:
+                from ..utils import comm_stats as _comm
+                e.analysis["collectives"] = _comm.analyze_compiled(
+                    compiled,
+                    axis_groups=_comm.axis_groups_from_shape(shape))
+            except Exception:
+                self._m_failures.labels(stage="collectives").inc()
         if not cost and not mem:
             # both analyses degraded (profiler counted each); entry
             # stays timing-only but records why
             e.analysis_error = e.analysis_error or \
                 "cost_analysis/memory_analysis unavailable"
         return True
+
+    def _normalized_arg_shapes(self, e: ExecEntry):
+        """Arg structs safe to AOT-lower.  A first call mixes
+        mesh-committed operands (params, cache) with host-resident ones
+        (the first token batch), and ``lower()`` rejects the mixed
+        device sets it would accept at runtime.  When the entry records
+        a multi-device submesh, rebuild it and commit every leaf that
+        does not already span it as REPLICATED on that submesh — which
+        is where GSPMD puts those operands at runtime anyway."""
+        sub = (e.meta or {}).get("submesh") or {}
+        shape, dev_ids = sub.get("shape") or {}, sub.get("devices") or []
+        if len(dev_ids) <= 1:
+            return e._arg_shapes
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        try:
+            by_id = {d.id: d for d in jax.devices()}
+            mesh = Mesh(
+                np.asarray([by_id[i] for i in dev_ids]).reshape(
+                    [int(n) for n in shape.values()]),
+                tuple(shape.keys()))
+            repl = NamedSharding(mesh, PartitionSpec())
+            dev_set = frozenset(dev_ids)
+
+            def fix(leaf):
+                if not isinstance(leaf, jax.ShapeDtypeStruct):
+                    return leaf
+                sh = leaf.sharding
+                ids = {d.id for d in sh.device_set} if sh is not None \
+                    else set()
+                if ids == dev_set:
+                    return leaf
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=repl)
+            return jax.tree_util.tree_map(fix, e._arg_shapes)
+        except Exception:
+            return e._arg_shapes
 
     def analyze_all(self, component: Optional[str] = None) -> int:
         """Analyze every (matching) entry; returns how many have
@@ -432,6 +484,8 @@ class ExecRegistry:
                 d[fld] = int(mem[fld])
         if e.analysis.get("out_shardings"):
             d["out_shardings"] = e.analysis["out_shardings"]
+        if e.analysis.get("collectives"):
+            d["collectives"] = e.analysis["collectives"]
         flops = cost.get("flops") or 0.0
         nbytes = cost.get("bytes_accessed") or 0.0
         if mean_ms and mean_ms > 0:

@@ -87,6 +87,11 @@ class FleetAggregator:
         new = 0
         for i, r in enumerate(self.replicas):
             lbl = str(i)
+            # remote replicas (router.RPCReplicaProxy) expose cached
+            # snapshots — pull a fresh one before reading them
+            refresh = getattr(r, "refresh_stats", None)
+            if callable(refresh):
+                refresh()
             seen = self._seen[i]
             for rid, rec in list(r.request_stats.items()):
                 if rid in seen:

@@ -80,6 +80,36 @@ def decode_attention_available() -> bool:
         return False
 
 
+def _tp_mesh(hkv: int, h: int):
+    """The active serving/compile mesh when its 'tp' axis can partition
+    these heads, else (None, 1).  The Pallas calls below are custom
+    calls GSPMD cannot partition — under a tp-sharded serving engine
+    (ISSUE 18) the entry points wrap them in shard_map over 'tp' with
+    per-shard head ranges instead, so each device streams only its own
+    KV-head slice (no collectives: decode attention is per-head).  The
+    axis name matches the serving engines' create_mesh({'dp','tp'})
+    convention (GPTConfig.tp_axis default)."""
+    try:
+        from ..distributed.mesh import get_mesh
+        mesh = get_mesh()
+    except Exception:  # pragma: no cover - circular-import safety
+        return None, 1
+    if mesh is None or "tp" not in mesh.axis_names:
+        return None, 1
+    tp = int(mesh.shape["tp"])
+    if tp <= 1 or hkv % tp or h % tp:
+        return None, 1
+    return mesh, tp
+
+
+def _shard_over_tp(body, mesh, in_specs, out_spec, args):
+    """shard_map `body` over the mesh with the given per-operand
+    PartitionSpecs (axes a spec does not name stay replicated)."""
+    from ..distributed.mesh import shard_map
+    return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=out_spec, check_vma=False)(*args)
+
+
 def _decode_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, block_k: int,
                    scale: float):
     """One (b·hkv) program: q_ref [G, D] query group; k/v [S, D] cache
@@ -271,12 +301,33 @@ def decode_attention(q, k_cache, v_cache, lengths, k_scale=None,
     if not supported or not decode_attention_available():
         return _decode_composite(q, k_cache, v_cache, lengths,
                                  k_scale, v_scale)
+    mesh, _tp = _tp_mesh(hkv, h)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        specs = [P(None, "tp", None), P(None, None, "tp", None),
+                 P(None, None, "tp", None), P(None)]
+        args = [q, k_cache, v_cache, lengths]
+        if quantized:
+            specs += [P(None, None, "tp"), P(None, None, "tp")]
+            args += [k_scale, v_scale]
+        return _shard_over_tp(_decode_kernel_path, mesh, specs,
+                              P(None, "tp", None), args)
+    return _decode_kernel_path(q, k_cache, v_cache, lengths, k_scale,
+                               v_scale)
+
+
+def _decode_kernel_path(q, k_cache, v_cache, lengths, k_scale=None,
+                        v_scale=None):
+    """The dense kernel dispatch AFTER the support gate — also the
+    shard_map body under tp (per-shard head ranges, same code)."""
+    b, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
     mask = (jnp.arange(s)[None, :] <
             lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
     q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
     k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
     v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
-    if quantized:
+    if k_scale is not None:
         ks3 = jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2) \
             .reshape(b * hkv, 1, s)
         vs3 = jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2) \
@@ -519,8 +570,31 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
     if not supported or not paged_decode_attention_available():
         return _paged_composite(q, k_pool, v_pool, tables, lengths,
                                 k_scale, v_scale)
+    mesh, _tp = _tp_mesh(hkv, h)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        specs = [P(None, "tp", None), P(None, None, "tp", None),
+                 P(None, None, "tp", None), P(None, None), P(None)]
+        args = [q, k_pool, v_pool, tables, lengths]
+        if quantized:
+            specs += [P(None, None, "tp"), P(None, None, "tp")]
+            args += [k_scale, v_scale]
+        return _shard_over_tp(_paged_kernel_path, mesh, specs,
+                              P(None, "tp", None), args)
+    return _paged_kernel_path(q, k_pool, v_pool, tables, lengths,
+                              k_scale, v_scale)
+
+
+def _paged_kernel_path(q, k_pool, v_pool, tables, lengths, k_scale=None,
+                       v_scale=None):
+    """The paged kernel dispatch AFTER the support gate — also the
+    shard_map body under tp (block tables stay replicated: allocation
+    is host state, each shard walks the same tables over its own
+    head-slice of the pool)."""
+    b, h, d = q.shape
+    hkv = k_pool.shape[2]
     q3 = q.reshape(b, hkv, h // hkv, d).reshape(b * hkv, h // hkv, d)
-    if quantized:
+    if k_scale is not None:
         o3 = _paged_gqa_q(q3, k_pool, v_pool, k_scale, v_scale, tables,
                           lengths)
     else:
@@ -705,6 +779,27 @@ def decode_attention_window(q, k_cache, v_cache, lengths, k_scale=None,
     if not supported or not decode_attention_available():
         return _window_composite(q, k_cache, v_cache, lengths,
                                  k_scale, v_scale)
+    mesh, _tp = _tp_mesh(hkv, h)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        specs = [P(None, None, "tp", None), P(None, None, "tp", None),
+                 P(None, None, "tp", None), P(None)]
+        args = [q, k_cache, v_cache, lengths]
+        if quantized:
+            specs += [P(None, None, "tp"), P(None, None, "tp")]
+            args += [k_scale, v_scale]
+        return _shard_over_tp(_window_kernel_path, mesh, specs,
+                              P(None, None, "tp", None), args)
+    return _window_kernel_path(q, k_cache, v_cache, lengths, k_scale,
+                               v_scale)
+
+
+def _window_kernel_path(q, k_cache, v_cache, lengths, k_scale=None,
+                        v_scale=None):
+    """The dense window-kernel dispatch AFTER the support gate — also
+    the shard_map body under tp."""
+    b, w, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
     limit = lengths.astype(jnp.int32)[:, None] + \
         jnp.arange(w, dtype=jnp.int32)[None, :] + 1
     mask = (jnp.arange(s)[None, None, :] <
@@ -715,7 +810,7 @@ def decode_attention_window(q, k_cache, v_cache, lengths, k_scale=None,
     k3 = jnp.swapaxes(k_cache, 1, 2).reshape(b * hkv, s, d)
     v3 = jnp.swapaxes(v_cache, 1, 2).reshape(b * hkv, s, d)
     ks3 = vs3 = None
-    if quantized:
+    if k_scale is not None:
         ks3 = jnp.swapaxes(k_scale.astype(jnp.float32), 1, 2) \
             .reshape(b * hkv, 1, s)
         vs3 = jnp.swapaxes(v_scale.astype(jnp.float32), 1, 2) \
@@ -907,6 +1002,29 @@ def paged_decode_attention_window(q, k_pool, v_pool, tables, lengths,
     if not supported or not paged_decode_attention_available():
         return _paged_window_composite(q, k_pool, v_pool, tables,
                                        lengths, k_scale, v_scale)
+    mesh, _tp = _tp_mesh(hkv, h)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        specs = [P(None, None, "tp", None), P(None, None, "tp", None),
+                 P(None, None, "tp", None), P(None, None), P(None)]
+        args = [q, k_pool, v_pool, tables, lengths]
+        if quantized:
+            specs += [P(None, None, "tp"), P(None, None, "tp")]
+            args += [k_scale, v_scale]
+        return _shard_over_tp(
+            functools.partial(_paged_window_kernel_path, w=w), mesh,
+            specs, P(None, None, "tp", None), args)
+    return _paged_window_kernel_path(q, k_pool, v_pool, tables, lengths,
+                                     k_scale, v_scale, w=w)
+
+
+def _paged_window_kernel_path(q, k_pool, v_pool, tables, lengths,
+                              k_scale=None, v_scale=None, *, w):
+    """The paged window-kernel dispatch AFTER the support gate — also
+    the shard_map body under tp (tables replicated; each shard walks
+    the same tables over its own head-slice of the pool)."""
+    b, _, h, d = q.shape
+    hkv = k_pool.shape[2]
     q3 = q.reshape(b, w, hkv, h // hkv, d).transpose(0, 2, 1, 3, 4) \
         .reshape(b * hkv, w * (h // hkv), d)
     o3 = _paged_window_gqa(q3, k_pool, v_pool, tables, lengths, w,

@@ -51,6 +51,18 @@ qmm tiles from the unified tuning table) reproduces the COMPOSED
 kernels path op for op, which makes the composed engine the parity
 oracle: on CPU the two lower to the same XLA ops and agree bitwise; the
 Pallas kernel is tested against it in interpret mode at 1e-5.
+
+Tensor-parallel serving (ISSUE 18): the megakernel STANDS DOWN under
+tp>1.  Its whole-layer fusion assumes every projection's full weight is
+resident in one kernel's VMEM plan, which contradicts the tp layout
+(qkv/up column-split, out/down row-split with a psum between) — the
+per-head shard_map treatment that works for the attention-only decode
+kernels (ops.decode_attention) cannot cover the row-split matmuls
+without growing collectives inside the kernel.  ``gpt.
+_megakernel_active`` checks the live mesh and keeps the composed GSPMD
+path whenever the tp axis has extent > 1; ``engine.stats
+["decode_megakernel"]`` reports what actually runs, so an armed knob
+that stood down is visible, not silent.
 """
 from __future__ import annotations
 

@@ -30,7 +30,8 @@ from typing import Dict, Optional
 
 __all__ = ["parse_hlo_collectives", "estimate_comm_ms",
            "estimate_dcn_ms", "analyze_compiled", "analyze_jit",
-           "empty_breakdown", "COLLECTIVE_KINDS"]
+           "empty_breakdown", "COLLECTIVE_KINDS",
+           "axis_groups_from_shape", "mesh_axis_groups"]
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
                     "all-to-all", "collective-permute")
@@ -165,6 +166,52 @@ def _parse_replica_groups(line: str):
     return None
 
 
+def axis_groups_from_shape(shape) -> Dict[str, list]:
+    """Logical-device-id groups per mesh axis from an ORDERED
+    ``{axis: size}`` mapping (order must match the mesh's axis order —
+    XLA replica groups index the flattened device assignment).  Axes of
+    extent 1 are dropped.  This is how a serving-mesh collective gets
+    ATTRIBUTED: an all-reduce whose replica groups equal the 'tp'
+    groups is tp traffic (the RowParallelLinear partial-sum reduce of a
+    tp-sharded decode), one matching 'dp' is data-parallel traffic."""
+    import numpy as _np
+    names = list(shape)
+    dims = [int(shape[a]) for a in names]
+    n = 1
+    for d in dims:
+        n *= d
+    idx = _np.arange(n).reshape(dims)
+    out: Dict[str, list] = {}
+    for i, ax in enumerate(names):
+        if dims[i] <= 1:
+            continue
+        rows = _np.moveaxis(idx, i, -1).reshape(-1, dims[i])
+        out[ax] = [frozenset(int(x) for x in r) for r in rows]
+    return out
+
+
+def mesh_axis_groups(mesh) -> Dict[str, list]:
+    """axis_groups_from_shape over a live jax Mesh."""
+    return axis_groups_from_shape(
+        {ax: int(sz) for ax, sz in mesh.shape.items()})
+
+
+def _match_axis(groups, axis_sets: Dict[str, set], n_dev: int) -> str:
+    """Name the mesh axis whose group partition equals this op's
+    replica groups; 'all' for a single global group on a multi-axis
+    mesh, 'other' for anything unrecognized (merged-axis collectives)."""
+    if groups is None:
+        gset = {frozenset(range(n_dev))}
+    else:
+        gset = {frozenset(g) for g in groups}
+    for ax, gs in axis_sets.items():
+        if gset == gs:
+            return ax
+    if gset == {frozenset(range(n_dev))}:
+        return "all"
+    return "other"
+
+
 def _crosses_slice(groups, slice_size: int) -> bool:
     """True when any replica group spans two DCN slices (device id //
     slice_size).  No groups recorded means one global group — that
@@ -179,7 +226,8 @@ def _crosses_slice(groups, slice_size: int) -> bool:
 
 
 def parse_hlo_collectives(hlo_text: str,
-                          slice_size: Optional[int] = None) -> Dict:
+                          slice_size: Optional[int] = None,
+                          axis_groups: Optional[Dict] = None) -> Dict:
     """Scan optimized HLO for collective ops.
 
     Returns {"count": int, "bytes": int, "by_op": {kind: {"count", "bytes"}}}
@@ -193,7 +241,13 @@ def parse_hlo_collectives(hlo_text: str,
     bytes into "ici_bytes" (replica groups contained in one slice) vs
     "dcn_bytes" (groups spanning slices — the cross-datacenter-network
     traffic), per kind and as top-level totals: the evidence the
-    hierarchical-DP parity phase and the dcn-bound doctor rule read."""
+    hierarchical-DP parity phase and the dcn-bound doctor rule read.
+
+    axis_groups (``mesh_axis_groups``/``axis_groups_from_shape``)
+    additionally attributes every op to the MESH AXIS whose group
+    partition its replica groups equal — the ISSUE 18 tp/dp collective
+    split for serving executables — as a top-level ``by_axis``
+    {axis: {"count", "bytes"}} breakdown."""
     lines_by_comp: Dict[str, list] = {"": []}
     comp = ""
     for line in hlo_text.splitlines():
@@ -208,6 +262,12 @@ def parse_hlo_collectives(hlo_text: str,
     mults = _while_multipliers(lines_by_comp)
 
     split = slice_size is not None and slice_size > 0
+    attribute = bool(axis_groups)
+    if attribute:
+        axis_sets = {ax: set(gs) for ax, gs in axis_groups.items()}
+        n_dev = max(max(g) for gs in axis_groups.values()
+                    for g in gs) + 1
+        by_axis: Dict[str, Dict[str, int]] = {}
     by_op = {k: {"count": 0, "bytes": 0} for k in COLLECTIVE_KINDS}
     if split:
         for v in by_op.values():
@@ -223,10 +283,17 @@ def parse_hlo_collectives(hlo_text: str,
                     async_start=bool(m.group("async")), kind=kind)
                 by_op[kind]["count"] += scale
                 by_op[kind]["bytes"] += b
+                if split or attribute:
+                    gl = _parse_replica_groups(line)
                 if split:
-                    cross = _crosses_slice(
-                        _parse_replica_groups(line), slice_size)
+                    cross = _crosses_slice(gl, slice_size)
                     by_op[kind]["dcn_bytes" if cross else "ici_bytes"] += b
+                if attribute:
+                    slot = by_axis.setdefault(
+                        _match_axis(gl, axis_sets, n_dev),
+                        {"count": 0, "bytes": 0})
+                    slot["count"] += scale
+                    slot["bytes"] += b
     total_c = sum(v["count"] for v in by_op.values())
     total_b = sum(v["bytes"] for v in by_op.values())
     out = {"count": total_c, "bytes": total_b,
@@ -236,6 +303,8 @@ def parse_hlo_collectives(hlo_text: str,
                                for v in out["by_op"].values())
         out["dcn_bytes"] = sum(v["dcn_bytes"]
                                for v in out["by_op"].values())
+    if attribute:
+        out["by_axis"] = by_axis
     return out
 
 
@@ -321,7 +390,8 @@ def _degraded(stage: str, exc: BaseException) -> Dict:
 
 
 def analyze_compiled(compiled, device=None,
-                     slice_size: Optional[int] = None) -> Dict:
+                     slice_size: Optional[int] = None,
+                     axis_groups: Optional[Dict] = None) -> Dict:
     """Collective breakdown + comm_ms estimate of one compiled XLA
     executable (a `jax.stages.Compiled`).  Never raises: a backend
     where ``as_text``/parsing fails yields ``empty_breakdown()`` with a
@@ -329,10 +399,12 @@ def analyze_compiled(compiled, device=None,
 
     slice_size enables the ici/dcn byte split (see
     parse_hlo_collectives); comm_ms then prices ICI and DCN bytes at
-    their own bandwidths instead of pretending the slow tier is ICI."""
+    their own bandwidths instead of pretending the slow tier is ICI.
+    axis_groups enables the per-mesh-axis attribution ("by_axis")."""
     try:
         txt = compiled.as_text()
-        out = parse_hlo_collectives(txt, slice_size=slice_size)
+        out = parse_hlo_collectives(txt, slice_size=slice_size,
+                                    axis_groups=axis_groups)
         if "dcn_bytes" in out:
             out["comm_ms"] = round(
                 estimate_comm_ms(out["ici_bytes"], device)
@@ -346,7 +418,8 @@ def analyze_compiled(compiled, device=None,
 
 
 def analyze_jit(jitfn, *args, device=None,
-                slice_size: Optional[int] = None) -> Optional[Dict]:
+                slice_size: Optional[int] = None,
+                axis_groups: Optional[Dict] = None) -> Optional[Dict]:
     """AOT lower+compile `jitfn` at `args` (values or ShapeDtypeStructs)
     and analyze its collectives.  Returns None when lowering/compiling
     fails (the caller's step still runs; stats just stay unmeasured,
@@ -357,4 +430,5 @@ def analyze_jit(jitfn, *args, device=None,
     except Exception as e:
         _degraded("analyze_jit", e)
         return None
-    return analyze_compiled(compiled, device=device, slice_size=slice_size)
+    return analyze_compiled(compiled, device=device,
+                            slice_size=slice_size, axis_groups=axis_groups)

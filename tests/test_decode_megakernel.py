@@ -389,9 +389,13 @@ def test_bench_resume_serve_rows(tmp_path, monkeypatch):
            "gen_tokens": 64, "value": 900.0}
     bench._persist_row(row, kind="serve")
     measured = bench._measured_rows("serve")
-    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64)
+    # tp joined the candidate key (ISSUE 18): a row without the column
+    # resumes as the tp=1 candidate, a tp=2 row is a DIFFERENT point
+    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1)
     assert key in measured and measured[key]["value"] == 900.0
-    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64) \
+    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64, 1) \
+        not in measured
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 2) \
         not in measured
 
 
