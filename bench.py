@@ -154,7 +154,7 @@ def _serve_row_key(row) -> tuple:
             str(row.get("kv_dtype") or "dense"),
             bool(row.get("decode_megakernel")),
             int(row.get("prompt_len", 0)), int(row.get("gen_tokens", 0)),
-            int(row.get("tp", 1) or 1))
+            int(row.get("tp", 1) or 1), int(row.get("ep", 1) or 1))
 
 
 def _measured_rows(kind) -> dict:
@@ -915,8 +915,11 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         # token (int8-aware; the fused kernel's saving as a NUMBER)
         "decode_megakernel": stats["decode_megakernel"],
         "decode_hbm_bytes_per_tok": stats["decode_hbm_bytes_per_tok"],
-        # pod-scale serving (ISSUE 18): the tensor-parallel sweep axis
+        # pod-scale serving (ISSUE 18/19): the tensor- and
+        # expert-parallel sweep axes (both join the resume row key)
         "tp": stats["tp"],
+        "ep": stats["ep"],
+        "moe_num_experts": stats.get("moe_num_experts", 0),
         "serving_mesh": stats.get("serving_mesh"),
         "compile_ms_cold": stats["compile_ms_cold"],
         "xla_compiles_measured": snap.new_compiles,
@@ -926,6 +929,12 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }
+    if stats.get("moe_num_experts"):
+        # expert-balance columns (ISSUE 19): the load histogram,
+        # overflow rate and skew the expert-imbalance doctor rule reads
+        for k in ("moe_expert_load", "moe_dropped_rate",
+                  "moe_load_skew", "moe_assigned_tokens"):
+            out[k] = stats.get(k)
     # perf-doctor verdict for this row (observability.doctor): the
     # engine's serving signals + this window's measured compile count
     from paddle_tpu.observability import doctor as _doctor
@@ -964,6 +973,13 @@ def bench_serve(config_name=None, batch_slots=None, prompt_len=None,
         # device count can't change in an already-imported jax
         _smoke_serve_tp()
         out["serve_tp_smoke"] = True
+        # ep=2 CPU-mesh leg (ISSUE 19): expert-parallel MoE serving
+        # parity on the same 8-virtual-device subprocess pattern
+        _smoke_serve_ep()
+        out["serve_ep_smoke"] = True
+        # tier-1 wall-budget guard (ISSUE 19 satellite): fail the smoke
+        # when a test file's fast lane outgrows the per-file budget
+        _smoke_tier1_budget()
     # executable observatory (ISSUE 15): analyze AFTER the measured
     # window + smoke assertions (the AOT re-lower is a compile the
     # 0-compile contract must not see) and attach the per-executable
@@ -1340,7 +1356,8 @@ def bench_multichip_child():
     for fn in (multichip.run_zero3_phase, multichip.run_1f1b_phase,
                multichip.run_moe_a2a_phase,
                multichip.run_elastic_restore_phase,
-               multichip.run_dcn_phase, multichip.run_serve_tp_phase):
+               multichip.run_dcn_phase, multichip.run_serve_tp_phase,
+               multichip.run_serve_ep_phase):
         r = fn()
         phases.append(r)
         log(f"  multichip phase {r['name']} ok t={r['t_s']}s")
@@ -1366,6 +1383,69 @@ def bench_serve_tp_child():
     out["metric"] = "serve_tp_smoke"
     out["ok"] = True
     print(json.dumps(out))
+
+
+def bench_serve_ep_child():
+    """Child half of the --serve --smoke ep leg (runs with
+    JAX_PLATFORMS=cpu and 8 virtual host devices): ep=2 expert-parallel
+    MoE serving must be token-identical to the replicated ep=1 engine
+    on both KV layouts, recompile-free after warmup, with 'ep' submesh
+    meta and a2a bytes attributed to the ep axis.  Prints ONE JSON
+    line; any violated contract raises and exits non-zero."""
+    from paddle_tpu.testing import multichip
+    out = multichip.run_serve_ep_phase()
+    out["metric"] = "serve_ep_smoke"
+    out["ok"] = True
+    print(json.dumps(out))
+
+
+def _smoke_serve_ep(n_devices=8):
+    """ep=2 CPU-mesh leg of --serve --smoke (ISSUE 19): the same
+    re-exec pattern as the tp leg — expert-parallel serving needs a
+    multi-device mesh jax can no longer grow in this process."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_PLATFORM_NAME="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(kept)
+    for k in [k for k in env
+              if k.startswith(("AXON_", "PALLAS_AXON_", "TPU_"))]:
+        env.pop(k, None)
+    env.pop("PADDLE_TPU_SERVE_TP", None)   # the child builds its own mesh
+    env.pop("PADDLE_TPU_SERVE_EP", None)
+    rc = subprocess.call(
+        [sys.executable, "-u", os.path.abspath(__file__),
+         "--serve-ep-child"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    if rc != 0:
+        raise SystemExit(
+            f"serve --smoke: ep=2 CPU-mesh leg failed (exit {rc})")
+    log("  serve ep=2 smoke ok (MoE parity + 0 compiles + ep a2a bytes)")
+
+
+def _smoke_tier1_budget():
+    """Tier-1 wall-budget guard (ISSUE 19 satellite): read the recorded
+    per-file fast-lane durations and fail the smoke when any
+    non-exempt test file exceeds the per-file budget — the 870s tier-1
+    wall budget stays honest because an overgrown file must either
+    shed tests to @pytest.mark.slow or claim an explicit exemption.
+    Graceful no-op when no durations file has been recorded yet."""
+    from paddle_tpu.testing import tier1_budget
+    verdict = tier1_budget.check_recorded_durations()
+    if verdict is None:
+        log("  tier1 budget: no durations file recorded — skipped")
+        return
+    if verdict["over_budget"]:
+        raise SystemExit(
+            "bench --smoke: tier-1 per-file budget exceeded: "
+            + "; ".join(
+                f"{f} {s:.1f}s > {verdict['budget_s']:.0f}s"
+                for f, s in verdict["over_budget"])
+            + " — move tests to @pytest.mark.slow or exempt the file "
+              "in PADDLE_TPU_TIER1_EXEMPT")
+    log(f"  tier1 budget ok: {verdict['files']} file(s) within "
+        f"{verdict['budget_s']:.0f}s each")
 
 
 def _smoke_serve_tp(n_devices=8):
@@ -2104,6 +2184,10 @@ def main():
 
     if "--serve-tp-child" in sys.argv:
         bench_serve_tp_child()
+        return
+
+    if "--serve-ep-child" in sys.argv:
+        bench_serve_ep_child()
         return
 
     if "--multichip-child" in sys.argv:

@@ -48,7 +48,9 @@ from .mesh import axis_size as _axis_size
 from .parallel_layers import mark_sharding, _in_shard_map
 
 __all__ = ["MoELayer", "ExpertParallelFFN", "top_k_gating",
-           "collect_aux_losses", "add_aux_loss", "moe_capacity"]
+           "collect_aux_losses", "add_aux_loss", "moe_capacity",
+           "collect_expert_stats", "record_expert_stats",
+           "fold_expert_stats", "nearest_chunk_divisors"]
 
 
 # ---------------------------------------------------------------------------
@@ -84,6 +86,67 @@ def moe_capacity(tokens_per_group: int, num_experts: int, top_k: int,
     """Expert capacity per token group (Switch: cf * k * S / E)."""
     return max(1, int(math.ceil(
         capacity_factor * top_k * tokens_per_group / num_experts)))
+
+
+# ---------------------------------------------------------------------------
+# Expert-balance stats: serving wants per-expert load and dropped-token
+# (capacity-overflow) accounting without extra host syncs. The engine
+# opens a collector inside its jitted step while TRACING; every MoE
+# layer the trace hits records its traced kept-token load, and the fold
+# rides out of the executable as one extra output fetched at the step's
+# existing readback point.
+# ---------------------------------------------------------------------------
+_EXPERT_STATS_STACK: List[list] = []
+
+
+@contextlib.contextmanager
+def collect_expert_stats():
+    """Collect per-layer expert-balance stats (kept-token load [E] +
+    statically-known assigned count) emitted by MoE layers during a
+    forward trace. Yields the list; fold with fold_expert_stats()."""
+    bucket: list = []
+    _EXPERT_STATS_STACK.append(bucket)
+    try:
+        yield bucket
+    finally:
+        _EXPERT_STATS_STACK.pop()
+
+
+def record_expert_stats(load, assigned: int):
+    """MoE layers call this with their per-expert KEPT-token counts
+    ``load [E]`` (dispatch mask sums — may be traced) and the static
+    number of (token, expert) assignments the router made
+    (``top_k * B * S``); dropped = assigned - sum(load). No-op when no
+    collector is open (training, eager use)."""
+    if _EXPERT_STATS_STACK:
+        _EXPERT_STATS_STACK[-1].append(
+            {"load": load, "assigned": int(assigned)})
+
+
+def fold_expert_stats(bucket):
+    """Sum a collector's per-layer records into ONE fixed-shape pytree
+    ``{"load": [E] f32, "assigned": f32 scalar}`` suitable as an extra
+    jit output; None when the trace hit no MoE layer (static per model
+    config, so executable signatures stay stable)."""
+    if not bucket:
+        return None
+    load = bucket[0]["load"].astype(jnp.float32)
+    for rec in bucket[1:]:
+        load = load + rec["load"].astype(jnp.float32)
+    assigned = jnp.asarray(
+        float(sum(r["assigned"] for r in bucket)), jnp.float32)
+    return {"load": load, "assigned": assigned}
+
+
+def nearest_chunk_divisors(n: int, k: int):
+    """The valid a2a chunk counts nearest a requested k: the largest
+    divisor of n that is <= k and the smallest that is >= k (for the
+    divisibility error message — naming what WOULD work beats
+    restating the constraint)."""
+    k = max(1, min(int(k), int(n)))
+    lower = next(d for d in range(k, 0, -1) if n % d == 0)
+    higher = next(d for d in range(k, n + 1) if n % d == 0)
+    return lower, higher
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +295,7 @@ class MoELayer(Layer):
         logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), gate)
         dispatch, combine, aux, zloss = top_k_gating(
             logits, self.top_k, cap, self.normalize_gates)
+        load = jnp.sum(dispatch, axis=(0, 1, 3))     # [E] kept tokens
         dispatch = dispatch.astype(x.dtype)
         combine = combine.astype(x.dtype)
         # token->expert buffer; resharding B('dp') -> E('ep') here IS the
@@ -247,7 +311,7 @@ class MoELayer(Layer):
         ye = self._constrain(ye, PartitionSpec("dp", self.ep_axis,
                                                None, None))
         y = jnp.einsum("bsec,bech->bsh", combine, ye)
-        return y, aux, zloss
+        return y, aux, zloss, load
 
     # -- explicit all_to_all formulation (inside shard_map, dp==ep) --
     def _fn_shard_map(self, x, gate, w_up, b_up, w_down, b_down):
@@ -288,11 +352,14 @@ class MoELayer(Layer):
             # get numbers for a different K than they asked for
             k = int(self.a2a_chunks)
             if k < 1 or (b * cap) % k:
+                lo, hi = nearest_chunk_divisors(b * cap, k)
                 raise ValueError(
                     f"a2a_chunks={k} must divide the per-device token "
                     f"slots b*capacity={b * cap} (b={b}, capacity="
-                    f"{cap}); pick a divisor or leave a2a_chunks=None "
-                    f"for the auto-clamped default")
+                    f"{cap}); the nearest valid chunk counts are "
+                    f"{lo} (below) and {hi} (above) — pick one, or "
+                    f"leave a2a_chunks=None for the auto-clamped "
+                    f"default")
         else:
             # env/default resolution clamps to the nearest divisor
             from .overlap import moe_a2a_chunks as _resolve_chunks
@@ -318,6 +385,139 @@ class MoELayer(Layer):
         y = jnp.einsum("bsec,ebch->bsh", combine, ye)
         return y, aux, zloss
 
+    # -- serving formulation: ep-sharded experts, replicated tokens ---
+    def _serve_ep_mesh(self):
+        """The compile mesh when the expert-parallel SERVING dispatch
+        can run for this trace, else None.  Conditions: inference (the
+        training formulations own their paths), a compile mesh bound by
+        the engine's trace guard carrying a real 'ep' axis, divisible
+        experts, and not already inside a shard_map."""
+        if self.training or _in_shard_map(self.ep_axis):
+            return None
+        from .mesh import get_compile_mesh
+        mesh = get_compile_mesh()
+        if (mesh is None or self.ep_axis not in mesh.axis_names
+                or mesh.shape[self.ep_axis] <= 1):
+            return None
+        if self.num_experts % mesh.shape[self.ep_axis]:
+            return None
+        return mesh
+
+    def _serve_chunks(self, c_loc: int) -> int:
+        """a2a chunk count for the serving dispatch: an explicit
+        a2a_chunks must divide the per-device capacity slice c_loc (the
+        chunks partition it); None resolves from the overlap knob
+        (PADDLE_TPU_MOE_A2A_CHUNKS / tuning-table op 'moe_a2a_chunks')
+        and clamps DOWN to the nearest divisor."""
+        if self.a2a_chunks is not None:
+            k = int(self.a2a_chunks)
+            if k < 1 or c_loc % k:
+                lo, hi = nearest_chunk_divisors(c_loc, k)
+                raise ValueError(
+                    f"a2a_chunks={k} must divide the per-device "
+                    f"capacity slice {c_loc} of the serving expert "
+                    f"dispatch; the nearest valid chunk counts are "
+                    f"{lo} (below) and {hi} (above) — pick one, or "
+                    f"leave a2a_chunks=None for the auto-clamped "
+                    f"default")
+            return k
+        from .overlap import moe_a2a_chunks as _resolve_chunks
+        k = max(1, min(_resolve_chunks(c_loc), c_loc))
+        while c_loc % k:
+            k -= 1
+        return k
+
+    def _fn_serve_ep(self, mesh, x, gate, w_up, b_up, w_down, b_down):
+        """Expert-parallel SERVING dispatch (decode [B,1,H], verify
+        [B,W,H], prefill [1,S,H]) under shard_map over the full serving
+        mesh: tokens and the router stay replicated — every device
+        computes the FULL gating, bitwise the ep=1 dense formulation,
+        which is what keeps ep>1 token-identical — while expert weights
+        arrive ep-sharded.  Each device owns a 1/ep slice of the
+        capacity dim: chunked all-to-all sends its slice's tokens to
+        the experts' owners (split E, concat C), the local expert FFN
+        runs, the reverse all-to-all returns outputs, and a partial
+        combine + psum over 'ep' rebuilds the replicated [B,S,H].  The
+        capacity dim is zero-padded up front so the slices are equal —
+        padded slots carry zero combine weight, so shapes are fixed
+        (the zero-recompile contract survives) and the math is exact.
+        """
+        from .mesh import shard_map
+        axis = self.ep_axis
+        ep = int(mesh.shape[axis])
+        b, s, h = x.shape
+        n_exp = self.num_experts
+        cap = moe_capacity(s, n_exp, self.top_k, self.capacity_factor)
+        cap_pad = -(-cap // ep) * ep
+        c_loc = cap_pad // ep
+        n_chunks = self._serve_chunks(c_loc)
+        csz = c_loc // n_chunks
+
+        def body(xs, gate_r, wu, bu, wd, bd):
+            logits = jnp.einsum("bsh,he->bse",
+                                xs.astype(jnp.float32), gate_r)
+            dispatch, combine, aux, zloss = top_k_gating(
+                logits, self.top_k, cap, self.normalize_gates)
+            load = jnp.sum(dispatch, axis=(0, 1, 3))   # [E] kept
+            dispatch = dispatch.astype(xs.dtype)
+            combine = combine.astype(xs.dtype)
+            xe = jnp.einsum("bsec,bsh->ebch", dispatch, xs)  # [E,b,C,H]
+            if cap_pad > cap:
+                xe = jnp.pad(xe, ((0, 0), (0, 0),
+                                  (0, cap_pad - cap), (0, 0)))
+                combine = jnp.pad(combine, ((0, 0), (0, 0), (0, 0),
+                                            (0, cap_pad - cap)))
+            idx = jax.lax.axis_index(axis)
+            x_loc = jax.lax.dynamic_slice_in_dim(
+                xe, idx * c_loc, c_loc, axis=2)        # [E,b,c_loc,H]
+
+            def expert_ffn(xg):
+                """Local experts over a capacity-slice chunk
+                [E_loc, b, g, H] — pointwise per token slot, so
+                chunking the slice is exact."""
+                h1 = self.experts.act(
+                    jnp.einsum("ebgh,ehf->ebgf", xg,
+                               wu.astype(xs.dtype))
+                    + bu.astype(xs.dtype)[:, None, None, :])
+                return jnp.einsum("ebgf,efh->ebgh", h1,
+                                  wd.astype(xs.dtype)) \
+                    + bd.astype(xs.dtype)[:, None, None, :]
+
+            ye_chunks = []
+            for j in range(n_chunks):
+                xj = jax.lax.slice_in_dim(
+                    x_loc, j * csz, (j + 1) * csz, axis=2)
+                # dispatch: each device keeps its expert rows of every
+                # peer's capacity slice for this chunk
+                xj = jax.lax.all_to_all(
+                    xj, axis, split_axis=0, concat_axis=2,
+                    tiled=True)                  # [E_loc, b, csz*ep, H]
+                yj = expert_ffn(xj)
+                # combine: return the chunk's outputs to slice owners
+                yj = jax.lax.all_to_all(
+                    yj, axis, split_axis=2, concat_axis=0,
+                    tiled=True)                  # [E, b, csz, H]
+                ye_chunks.append(yj)
+            ye = ye_chunks[0] if n_chunks == 1 else \
+                jnp.concatenate(ye_chunks, axis=2)   # [E, b, c_loc, H]
+            comb_loc = jax.lax.dynamic_slice_in_dim(
+                combine, idx * c_loc, c_loc, axis=3)
+            y = jnp.einsum("bsec,ebch->bsh", comb_loc, ye)
+            y = jax.lax.psum(y, axis)
+            return y, aux, zloss, load
+
+        P = PartitionSpec
+        sm = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(), P(), P()),
+            # gating/combine are replicated by construction (identical
+            # inputs on every device) but flow through axis_index-
+            # derived slices the static replication checker cannot see
+            # through; the psum re-establishes the invariant
+            check_vma=False)
+        return sm(x, gate, w_up, b_up, w_down, b_down)
+
     def _constrain(self, arr, spec: PartitionSpec):
         """Best-effort sharding constraint: applied only under the
         COMPILE mesh a trainer publishes while tracing its step
@@ -341,21 +541,40 @@ class MoELayer(Layer):
             arr, NamedSharding(mesh, PartitionSpec(*names)))
 
     def forward(self, x):
+        import functools
         in_sm = _in_shard_map(self.ep_axis)
-        if not in_sm and self.a2a_chunks not in (None, 1):
-            # the GSPMD path's all-to-all is XLA-inserted (no manual
-            # exchange to chunk); silently ignoring an explicit K here
-            # would hand an A/B measurement the monolithic numbers
-            raise NotImplementedError(
-                f"a2a_chunks={self.a2a_chunks} only applies to the "
-                f"shard_map expert-parallel formulation (the '"
-                f"{self.ep_axis}' axis bound inside shard_map); the "
-                f"GSPMD path's all-to-all is inserted by XLA and cannot "
-                f"be chunked from here — leave a2a_chunks=None")
-        fn = self._fn_shard_map if in_sm else self._fn_dense
-        y, aux, zloss = apply(
+        serve_mesh = None if in_sm else self._serve_ep_mesh()
+        if in_sm:
+            fn = self._fn_shard_map
+        elif serve_mesh is not None:
+            # serving trace (engine compile-mesh guard) with a real
+            # 'ep' axis: ep-sharded experts + explicit chunked a2a
+            fn = functools.partial(self._fn_serve_ep, serve_mesh)
+        else:
+            if self.a2a_chunks not in (None, 1):
+                # the GSPMD path's all-to-all is XLA-inserted (no
+                # manual exchange to chunk); silently ignoring an
+                # explicit K here would hand an A/B measurement the
+                # monolithic numbers
+                raise NotImplementedError(
+                    f"a2a_chunks={self.a2a_chunks} only applies to the "
+                    f"shard_map expert-parallel formulations (the '"
+                    f"{self.ep_axis}' axis bound inside shard_map, or "
+                    f"the serving dispatch on an ep>1 mesh); the GSPMD "
+                    f"path's all-to-all is inserted by XLA and cannot "
+                    f"be chunked from here — leave a2a_chunks=None")
+            fn = self._fn_dense
+        out = apply(
             fn, x, self.gate, self.experts.w_up, self.experts.b_up,
             self.experts.w_down, self.experts.b_down, name="moe_layer")
+        if len(out) == 4:
+            y, aux, zloss, load = out
+            arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+            record_expert_stats(
+                load.data if isinstance(load, Tensor) else load,
+                self.top_k * arr.shape[0] * arr.shape[1])
+        else:
+            y, aux, zloss = out
         total_aux = aux * self.aux_loss_coeff
         if self.z_loss_coeff:
             total_aux = total_aux + zloss * self.z_loss_coeff

@@ -48,6 +48,7 @@ Flow per ``step()``:
 """
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -108,10 +109,21 @@ class PrefillWorker:
                 self._own_alloc, engine.block_size) \
                 if engine._prefix is not None else None
         dargs = (1,) if engine._donate else ()
-        self._cold_jit = jax.jit(engine._prefill_paged_cold_fn,
-                                 donate_argnums=dargs)
-        self._ext_jit = jax.jit(engine._prefill_paged_ext_fn,
-                                donate_argnums=dargs)
+        cold_fn = engine._prefill_paged_cold_fn
+        ext_fn = engine._prefill_paged_ext_fn
+        if self._own:
+            # distinct function identities: bound methods hash equal
+            # across attribute accesses, so jax's trace cache would
+            # otherwise REUSE the decode engine's traced jaxpr — fatal
+            # once the MoE serve-ep dispatch bakes its concrete mesh
+            # into a shard_map (the worker's group is a different
+            # device set).  functools.partial hashes by identity, so
+            # each wrapper traces under ITS mesh guard.
+            import functools
+            cold_fn = functools.partial(cold_fn)
+            ext_fn = functools.partial(ext_fn)
+        self._cold_jit = jax.jit(cold_fn, donate_argnums=dargs)
+        self._ext_jit = jax.jit(ext_fn, donate_argnums=dargs)
         self.prefills = 0
 
     # ---- the state domain _paged_prefill runs against -----------------
@@ -162,13 +174,13 @@ class PrefillWorker:
             row = np.zeros(eng.blocks_per_slot, np.int32)
             row[:n] = blocks
             ids = jnp.zeros((1, b), jnp.int32)
-            _, cache = eng._timed_exec(
+            _, cache, _ = eng._timed_exec(
                 "prefill_ms", ("disagg", b), self._cold_jit,
                 self.params, self.cache, ids, jnp.asarray(row),
                 np.int32(1), mesh=self.mesh)
             self.cache = cache
             if self._prefix is not None:
-                _, cache = eng._timed_exec(
+                _, cache, _ = eng._timed_exec(
                     "prefill_ms", ("disagg_ext", b), self._ext_jit,
                     self.params, self.cache, ids, jnp.asarray(row),
                     np.int32(0), np.int32(1), mesh=self.mesh)
@@ -206,12 +218,20 @@ class DisaggServingEngine:
     mesh, the rest the decode mesh; the KV handoff then crosses the
     group boundary as a gather -> resharding device_put -> scatter
     block transfer.  ``prefill_tp``/``decode_tp`` override each
-    group's tensor-parallel degree (default: the full group)."""
+    group's tensor-parallel degree (default: the full group);
+    ``prefill_ep``/``decode_ep`` (ISSUE 19) grow each group's mesh an
+    'ep' axis for MoE expert parallelism — expert FFN weights shard
+    over it per group and the MoE serving dispatch routes through the
+    fixed-shape capacity a2a on that group's devices.  Defaults come
+    from ``PADDLE_TPU_SERVE_EP`` so one env knob configures both the
+    monolithic and the disaggregated topology."""
 
     def __init__(self, model, prefills_per_step: int = 1,
                  handoff_depth: int = 4, prefill_devices: int = 0,
                  prefill_tp: Optional[int] = None,
-                 decode_tp: Optional[int] = None, **engine_kw):
+                 decode_tp: Optional[int] = None,
+                 prefill_ep: Optional[int] = None,
+                 decode_ep: Optional[int] = None, **engine_kw):
         engine_kw.setdefault("kv_layout", "paged")
         self._disjoint = int(prefill_devices) > 0
         prefill_mesh = None
@@ -227,13 +247,31 @@ class DisaggServingEngine:
                 raise ValueError(
                     f"prefill_devices={k} leaves no decode group "
                     f"(process has {len(devs)} devices)")
-            p_tp = int(prefill_tp or k)
-            d_tp = int(decode_tp or (len(devs) - k))
-            prefill_mesh = create_mesh({"dp": k // p_tp, "tp": p_tp},
+            n_dec = len(devs) - k
+            env_ep = os.environ.get("PADDLE_TPU_SERVE_EP", "").strip()
+            p_ep = int(prefill_ep if prefill_ep is not None
+                       else (env_ep or 1))
+            d_ep = int(decode_ep if decode_ep is not None
+                       else (env_ep or 1))
+            for nm, grp, ep in (("prefill", k, p_ep),
+                                ("decode", n_dec, d_ep)):
+                if ep < 1 or grp % ep != 0:
+                    raise ValueError(
+                        f"{nm}_ep={ep} does not divide the {nm} "
+                        f"group ({grp} devices)")
+            p_tp = int(prefill_tp or (k // p_ep))
+            d_tp = int(decode_tp or (n_dec // d_ep))
+
+            def _axes(n, tp, ep):
+                axes = {"dp": n // (tp * ep), "tp": tp}
+                if ep > 1:
+                    axes["ep"] = ep
+                return axes
+
+            prefill_mesh = create_mesh(_axes(k, p_tp, p_ep),
                                        devices=devs[:k])
-            engine_kw["mesh"] = create_mesh(
-                {"dp": (len(devs) - k) // d_tp, "tp": d_tp},
-                devices=devs[k:])
+            engine_kw["mesh"] = create_mesh(_axes(n_dec, d_tp, d_ep),
+                                            devices=devs[k:])
         self.decode = InferenceEngine(model, **engine_kw)
         self.worker = PrefillWorker(self.decode, mesh=prefill_mesh)
         self.prefills_per_step = int(prefills_per_step)
@@ -274,6 +312,12 @@ class DisaggServingEngine:
     @property
     def _timings(self):
         return self.decode._timings
+
+    @property
+    def _moe_load(self):
+        # worker prefills accumulate into the DECODE engine's expert
+        # counters (engine._accum_moe) — one combined histogram
+        return self.decode._moe_load
 
     @property
     def _prefix(self):
@@ -488,6 +532,9 @@ class DisaggServingEngine:
         s["disjoint_groups"] = self._disjoint
         if self._disjoint:
             s["handoff_transfers"] = self.transfers
+            s["prefill_mesh"] = {
+                str(ax): int(n)
+                for ax, n in self.worker.mesh.shape.items()}
             s["prefill_devices"] = [
                 int(d.id)
                 for d in np.asarray(self.worker.mesh.devices).flat]

@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 
 from ..distributed import async_dispatch
+from ..distributed import moe as _moe
 from ..func import functional_apply, functional_state
 from ..observability import capture as _capture
 from ..observability import doctor as _doctor
@@ -240,12 +241,24 @@ class InferenceEngine:
         # GSPMD follows the committed operand shardings.
         if mesh is None:
             env_tp = os.environ.get("PADDLE_TPU_SERVE_TP", "").strip()
-            if env_tp and int(env_tp) > 1:
+            # expert parallelism (ISSUE 19): PADDLE_TPU_SERVE_EP=N adds
+            # an 'ep' axis — MoE expert FFN weights shard over it and
+            # the MoE serving dispatch routes tokens with explicit
+            # chunked all-to-all (distributed.moe._fn_serve_ep)
+            env_ep = os.environ.get("PADDLE_TPU_SERVE_EP", "").strip()
+            tp = int(env_tp) if env_tp else 1
+            ep = int(env_ep) if env_ep else 1
+            if tp > 1 or ep > 1:
                 from ..distributed.mesh import create_mesh
-                mesh = create_mesh({"dp": 1, "tp": int(env_tp)})
+                axes = {"dp": 1, "tp": tp}
+                if ep > 1:
+                    axes["ep"] = ep
+                mesh = create_mesh(axes)
         self.mesh = mesh
         self.tp_degree = int(mesh.shape["tp"]) \
             if mesh is not None and "tp" in mesh.axis_names else 1
+        self.ep_degree = int(mesh.shape["ep"]) \
+            if mesh is not None and "ep" in mesh.axis_names else 1
         self._shard_warned = False
         if self.kv_layout == "paged":
             self._init_paged(cache_dtype, kv_block_size, kv_num_blocks,
@@ -340,7 +353,13 @@ class InferenceEngine:
             "deadline_retirements": 0, "drain_forced_retirements": 0,
             "spec_ticks": 0, "spec_tokens_committed": 0,
             "spec_slot_ticks": 0, "spec_capacity_retirements": 0,
+            "moe_assigned_tokens": 0.0, "moe_dropped_tokens": 0.0,
         }
+        # expert-balance accumulators (ISSUE 19): the per-expert load
+        # histogram summed over every executed step/prefill/tick, host
+        # float64 so a long-lived server never loses counts to f32
+        self._is_moe = int(getattr(cfg, "moe_num_experts", 0) or 0) > 0
+        self._moe_load: Optional[np.ndarray] = None
         # graceful drain / preemption hookup (SIGTERM'd server finishes
         # what it started): while draining, admission is closed
         self._draining = False
@@ -375,6 +394,17 @@ class InferenceEngine:
                 self, "spec_draft", self.telemetry_label,
                 _exec_registry.tree_bytes(self._spec.draft_params) +
                 _exec_registry.tree_bytes(self._spec.draft_cache))
+        if self._is_moe:
+            # expert-parallel HBM win as a ledger line (ISSUE 19): the
+            # "params" entry above is GLOBAL-shape math; this one is the
+            # PER-DEVICE expert-weight residency, read off the committed
+            # arrays' shard shapes — under ep>1 it drops ~ep× vs
+            # replicated, and the acceptance test asserts exactly that
+            _exec_registry.track_bytes(
+                self, "moe_experts", self.telemetry_label,
+                self._moe_expert_bytes_per_device(),
+                ep=self.ep_degree,
+                num_experts=int(cfg.moe_num_experts))
         self._tracer = _spans.tracer()
         self._profile = _capture.ProfileWindow.from_env(kind="serve")
         self._m_ticks = _metrics.counter(
@@ -467,6 +497,15 @@ class InferenceEngine:
                   and int(mesh.shape[ax]) > 1
                   and arr.shape[d] % int(mesh.shape[ax]) == 0)
             out.append(ax if ok else None)
+        # canonical form: trailing Nones dropped.  GSPMD reports a
+        # fully-replicated executable OUTPUT as P() — committing inputs
+        # as P(None,...) would be semantically identical but a
+        # DIFFERENT jit cache key, costing one spurious recompile on
+        # the first post-warmup call whose operand came back from
+        # another executable (seen on an ep-only mesh, where the KV
+        # cache is fully replicated end to end)
+        while out and out[-1] is None:
+            out.pop()
         return NamedSharding(mesh, P(*out))
 
     def _put(self, mesh, arr, dims):
@@ -561,22 +600,38 @@ class InferenceEngine:
             self._shard_failed("kv_cache", e)
 
     # ---- compiled functions -------------------------------------------
+    # Every model-running executable opens the MoE expert-stats
+    # collector around its trace (ISSUE 19): MoE layers record their
+    # per-expert dispatch load INSIDE the jitted program, the fold
+    # rides out as one extra [num_experts]-sized output fetched at the
+    # step's existing host sync — zero extra syncs, and a dense model
+    # folds to None (an empty pytree leaf group), so non-MoE engines
+    # compile byte-identical programs.
     def _prefill_fn(self, params, cache, ids, slot, prompt_len):
-        return functional_apply(self.model, "prefill", params,
-                                ids, cache, slot, prompt_len)
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(self.model, "prefill",
+                                             params, ids, cache, slot,
+                                             prompt_len)
+        return logits, cache, _moe.fold_expert_stats(b)
 
     def _prefill_paged_cold_fn(self, params, cache, ids, table_row,
                                suffix_len):
         # prefix_len is a STATIC Python 0: the cold path compiles with
         # the exact flash/composite attention of the dense prefill
-        return functional_apply(self.model, "prefill_paged", params,
-                                ids, cache, table_row, 0, suffix_len)
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(self.model, "prefill_paged",
+                                             params, ids, cache,
+                                             table_row, 0, suffix_len)
+        return logits, cache, _moe.fold_expert_stats(b)
 
     def _prefill_paged_ext_fn(self, params, cache, ids, table_row,
                               prefix_len, suffix_len):
-        return functional_apply(self.model, "prefill_paged", params,
-                                ids, cache, table_row, prefix_len,
-                                suffix_len)
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(self.model, "prefill_paged",
+                                             params, ids, cache,
+                                             table_row, prefix_len,
+                                             suffix_len)
+        return logits, cache, _moe.fold_expert_stats(b)
 
     def _sample_from_logits(self, logits, key, temps, top_ps):
         """Greedy when temps<=0, else temperature + (static) top-k +
@@ -603,20 +658,24 @@ class InferenceEngine:
 
     def _decode_fn(self, params, cache, tokens, active, key, temps,
                    top_ps):
-        logits, cache = functional_apply(self.model, "decode_step",
-                                         params, tokens, cache, active)
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(self.model, "decode_step",
+                                             params, tokens, cache,
+                                             active)
         key, sub = jax.random.split(key)
         nxt = self._sample_from_logits(logits, sub, temps, top_ps)
-        return nxt, key, cache
+        return nxt, key, cache, _moe.fold_expert_stats(b)
 
     def _decode_paged_fn(self, params, cache, tokens, tables, lengths,
                          key, temps, top_ps):
-        logits, cache = functional_apply(self.model, "decode_step_paged",
-                                         params, tokens, cache, tables,
-                                         lengths)
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(self.model,
+                                             "decode_step_paged",
+                                             params, tokens, cache,
+                                             tables, lengths)
         key, sub = jax.random.split(key)
         nxt = self._sample_from_logits(logits, sub, temps, top_ps)
-        return nxt, key, cache
+        return nxt, key, cache, _moe.fold_expert_stats(b)
 
     # ---- timing helpers -----------------------------------------------
     # executable-observatory kind per _timed key family (ISSUE 15): the
@@ -646,6 +705,11 @@ class InferenceEngine:
         if mesh is not None:
             tp = int(dict(mesh.shape).get("tp", 1))
             meta["tp"] = tp
+            # expert parallelism (ISSUE 19): the submesh shape below
+            # already carries every axis — recording ep explicitly lets
+            # the observatory (and comm_stats' per-axis collective
+            # fold) tell an expert-parallel decode apart at a glance
+            meta["ep"] = int(dict(mesh.shape).get("ep", 1))
             meta["submesh"] = {
                 "shape": {ax: int(n) for ax, n in mesh.shape.items()},
                 "devices": [int(d.id) for d in
@@ -954,11 +1018,12 @@ class InferenceEngine:
         plen = prompt.size
         req.t_admit = time.perf_counter()
         self._timings["prefill_tokens"] += bucket
-        logits, cache = self._timed_exec(
+        logits, cache, moe = self._timed_exec(
             "prefill_ms", ("prefill", bucket), self._prefill_jit,
             self.params, self.cache, jnp.asarray(ids),
             np.int32(slot), np.int32(plen))
         self.cache = cache
+        self._accum_moe(moe)
         self._record_admission(req, slot, plen, logits)
 
     def _admit_paged(self, req: Request, slot: int) -> bool:
@@ -1050,18 +1115,19 @@ class InferenceEngine:
         row = np.zeros(self.blocks_per_slot, np.int32)
         row[:len(blocks)] = blocks
         if prefix_len == 0:
-            logits, cache = self._timed_exec(
+            logits, cache, moe = self._timed_exec(
                 "prefill_ms", (key_prefix, bucket), cold_jit,
                 dom.params, dom.cache, jnp.asarray(ids),
                 jnp.asarray(row), np.int32(suffix.size),
                 mesh=dom.mesh)
         else:
-            logits, cache = self._timed_exec(
+            logits, cache, moe = self._timed_exec(
                 "prefill_ms", (key_prefix + "_ext", bucket), ext_jit,
                 dom.params, dom.cache, jnp.asarray(ids),
                 jnp.asarray(row), np.int32(prefix_len),
                 np.int32(suffix.size), mesh=dom.mesh)
         dom.cache = cache
+        self._accum_moe(moe)
 
         # trim: blocks past the REAL prompt extent only ever held bucket
         # padding — return them to the pool immediately
@@ -1315,7 +1381,7 @@ class InferenceEngine:
         self._m_active.set(n_active)
         tick_t0 = self._tracer.now_us() if self._tracer.active else 0.0
         if self.kv_layout == "paged":
-            nxt, self._key, cache = self._timed_exec(
+            nxt, self._key, cache, moe = self._timed_exec(
                 "decode_ms", ("decode", 0), self._decode_paged_jit,
                 self.params, self.cache,
                 jnp.asarray(self._next_token),
@@ -1324,7 +1390,7 @@ class InferenceEngine:
                 self._key, jnp.asarray(self._temps),
                 jnp.asarray(self._top_ps))
         else:
-            nxt, self._key, cache = self._timed_exec(
+            nxt, self._key, cache, moe = self._timed_exec(
                 "decode_ms", ("decode", 0), self._decode_jit,
                 self.params, self.cache,
                 jnp.asarray(self._next_token),
@@ -1332,9 +1398,12 @@ class InferenceEngine:
                 jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         self.cache = cache
         # the ONE host sync of the decode step: the scheduler needs the
-        # sampled ids for EOS retirement and admission
+        # sampled ids for EOS retirement and admission (the expert-load
+        # fold, when present, is a sibling output of the same executable
+        # — fetching it here rides the same sync)
         t0 = time.perf_counter()
         nxt_np = np.asarray(nxt)
+        self._accum_moe(moe)
         async_dispatch.record_host_sync()
         self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
         self._timings["decode_steps"] += 1
@@ -1572,7 +1641,9 @@ class InferenceEngine:
     def _warmup_dense(self, buckets):
         for b in (buckets or [self.buckets[0]]):
             ids = jnp.zeros((1, b), jnp.int32)
-            logits, cache = self._timed_exec(
+            # warmup runs throwaway tokens — its expert-load fold is
+            # discarded so the balance stats describe real traffic only
+            logits, cache, _ = self._timed_exec(
                 "prefill_ms", ("prefill", b), self._prefill_jit,
                 self.params, self.cache, ids, np.int32(0), np.int32(1))
             self.cache = cache
@@ -1580,7 +1651,7 @@ class InferenceEngine:
         self._timed_exec("prefill_ms", ("sample", 1), self._sample_jit,
                          logits, sub, jnp.zeros((1,), jnp.float32),
                          jnp.ones((1,), jnp.float32))
-        nxt, self._key, cache = self._timed_exec(
+        nxt, self._key, cache, _ = self._timed_exec(
             "decode_ms", ("decode", 0), self._decode_jit,
             self.params, self.cache,
             jnp.zeros(self.batch_slots, jnp.int32),
@@ -1615,14 +1686,14 @@ class InferenceEngine:
             row = np.zeros(self.blocks_per_slot, np.int32)
             row[:n] = blocks
             ids = jnp.zeros((1, b), jnp.int32)
-            logits, cache = self._timed_exec(
+            logits, cache, _ = self._timed_exec(
                 "prefill_ms", ("prefill_paged", b),
                 self._prefill_paged_cold_jit,
                 self.params, self.cache, ids, jnp.asarray(row),
                 np.int32(1))
             self.cache = cache
             if self._prefix is not None:
-                logits, cache = self._timed_exec(
+                logits, cache, _ = self._timed_exec(
                     "prefill_ms", ("prefill_paged_ext", b),
                     self._prefill_paged_ext_jit,
                     self.params, self.cache, ids, jnp.asarray(row),
@@ -1637,7 +1708,7 @@ class InferenceEngine:
                              jnp.ones((1,), jnp.float32))
         # decode over all-null tables: every write lands in the null
         # block, every slot length is 0 — pure compile fodder
-        nxt, self._key, cache = self._timed_exec(
+        nxt, self._key, cache, _ = self._timed_exec(
             "decode_ms", ("decode", 0), self._decode_paged_jit,
             self.params, self.cache,
             jnp.zeros(self.batch_slots, jnp.int32),
@@ -1646,6 +1717,48 @@ class InferenceEngine:
             jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         self.cache = cache
         return self
+
+    # ---- MoE expert-balance plumbing (ISSUE 19) -----------------------
+    def _accum_moe(self, moe):
+        """Fold one executable's expert-stats output (or None, the
+        dense-model case) into the host counters.  Called at the step's
+        existing host-sync point — the arrays are siblings of the
+        sampled ids, so fetching them costs no extra sync."""
+        if moe is None:
+            return
+        load = np.asarray(moe["load"], np.float64)
+        assigned = float(np.asarray(moe["assigned"]))
+        if self._moe_load is None:
+            self._moe_load = np.zeros_like(load)
+        self._moe_load += load
+        self._timings["moe_assigned_tokens"] += assigned
+        # capacity overflow: gating assigned top_k slots per token, the
+        # capacity buckets kept load.sum() of them — the shortfall is
+        # exactly the dropped (overflowed) expert assignments
+        self._timings["moe_dropped_tokens"] += max(
+            0.0, assigned - float(load.sum()))
+
+    def _moe_expert_param_names(self) -> List[str]:
+        """Parameter names of the expert FFN weights (the arrays the
+        'ep' axis shards).  The '.experts.' segment is the
+        MoELayer/ExpertParallelFFN naming contract; the replicated gate
+        is deliberately excluded."""
+        return [n for n in self.params if ".experts." in n]
+
+    def _moe_expert_bytes_per_device(self) -> int:
+        """PER-DEVICE resident bytes of the expert FFN weights, read
+        off the committed arrays' shard shapes (falls back to the
+        global shape for host-resident/unsharded arrays)."""
+        total = 0
+        for name in self._moe_expert_param_names():
+            arr = self.params[name]
+            shape = arr.shape
+            try:
+                shape = arr.sharding.shard_shape(arr.shape)
+            except Exception:
+                pass
+            total += int(np.prod(shape)) * jnp.dtype(arr.dtype).itemsize
+        return total
 
     def _decode_hbm_bytes_per_tok(self) -> int:
         """The decode loop's HBM read traffic per generated token, from
@@ -1657,13 +1770,27 @@ class InferenceEngine:
         planes the kernels stream alongside them.  Under a tp-sharded
         serving mesh the number is PER SHARD (ISSUE 18): each device
         streams its weight shard and its slice of the KV heads — the
-        whole point of tensor-parallel decode is this denominator."""
+        whole point of tensor-parallel decode is this denominator.
+        Expert FFN weights divide by 'ep', not 'tp' (ISSUE 19): a
+        device streams only its own expert shard."""
         tp = max(self.tp_degree, 1)
+        ep = max(self.ep_degree, 1)
+        expert_names = set(self._moe_expert_param_names()) \
+            if self._is_moe else set()
         pbytes = 0
-        for leaf in jax.tree_util.tree_leaves(self.params):
-            pbytes += int(np.prod(leaf.shape)) * \
-                jnp.dtype(leaf.dtype).itemsize
+        ebytes = 0
+        for name, leaf in self.params.items():
+            b = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            if name in expert_names:
+                ebytes += b
+            else:
+                pbytes += b
         pbytes //= tp
+        # mirror the sharding helpers: experts replicate when ep does
+        # not divide them, and the traffic number must say what runs
+        if ep > 1 and self.model.cfg.moe_num_experts % ep == 0:
+            ebytes //= ep
+        pbytes += ebytes
         cfg = self.model.cfg
         # KV heads split over tp only when they divide evenly (the
         # sharding helpers replicate otherwise — mirror that here)
@@ -1710,9 +1837,31 @@ class InferenceEngine:
         # reports); the megakernel flag reports what actually runs —
         # it stands down under tp>1 (see gpt._megakernel_active)
         s["tp"] = self.tp_degree
+        s["ep"] = self.ep_degree
         if self.mesh is not None:
             s["serving_mesh"] = {str(ax): int(n)
                                  for ax, n in self.mesh.shape.items()}
+        # expert-balance observability (ISSUE 19): the load histogram,
+        # the capacity-overflow rate, and the max/mean skew the
+        # 'expert-imbalance' doctor rule reads.  Dense models drop the
+        # moe_* accumulator keys entirely (same convention as spec).
+        if self._is_moe:
+            s["moe_num_experts"] = int(self.model.cfg.moe_num_experts)
+            load = self._moe_load
+            s["moe_expert_load"] = (
+                [round(float(v), 1) for v in load]
+                if load is not None else None)
+            assigned = t["moe_assigned_tokens"]
+            s["moe_dropped_rate"] = round(
+                t["moe_dropped_tokens"] / assigned, 4) if assigned else 0.0
+            if load is not None and float(load.sum()) > 0:
+                s["moe_load_skew"] = round(
+                    float(load.max()) / max(float(load.mean()), 1e-9), 3)
+            else:
+                s["moe_load_skew"] = None
+        else:
+            s.pop("moe_assigned_tokens", None)
+            s.pop("moe_dropped_tokens", None)
         from ..ops.decode_megakernel import megakernel_enabled
         s["decode_megakernel"] = (megakernel_enabled(self.model.cfg)
                                   and self.tp_degree == 1)

@@ -94,6 +94,8 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
     # report describes THIS window even on a reused engine (the same
     # snapshot-and-subtract bench.py uses for compile counters)
     t_snap = dict(engine._timings)
+    _load0 = getattr(engine, "_moe_load", None)
+    moe_load_snap = None if _load0 is None else _load0.copy()
     pc = engine._prefix
     # NB: the radix cache defines __len__, so an EMPTY tree is falsy —
     # the None-check must be identity, not truthiness
@@ -203,6 +205,29 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         report["prefix_queries"] = dq
         report["prefix_hit_rate"] = round(dh / dq, 4) if dq else 0.0
         report["prefix_hit_blocks"] = pc.hit_blocks - pc_snap[2]
+    # expert-balance columns (ISSUE 19), WINDOW-scoped like everything
+    # else here: per-expert routed-token load, capacity-overflow drop
+    # rate, and max/mean skew — the inputs the 'expert-imbalance'
+    # doctor rule reads off the merged dict below
+    if st.get("moe_num_experts"):
+        assigned = (t1["moe_assigned_tokens"]
+                    - t_snap.get("moe_assigned_tokens", 0.0))
+        dropped = (t1["moe_dropped_tokens"]
+                   - t_snap.get("moe_dropped_tokens", 0.0))
+        report["moe_num_experts"] = st["moe_num_experts"]
+        report["ep"] = st["ep"]
+        report["moe_assigned_tokens"] = round(assigned, 1)
+        report["moe_dropped_rate"] = round(dropped / assigned, 4) \
+            if assigned > 0 else 0.0
+        load = getattr(engine, "_moe_load", None)
+        if load is not None:
+            wload = load - (moe_load_snap if moe_load_snap is not None
+                            else 0.0)
+            report["moe_expert_load"] = [round(float(v), 1)
+                                         for v in wload]
+            mean = float(wload.mean())
+            report["moe_load_skew"] = round(float(wload.max()) / mean,
+                                            3) if mean > 0 else None
     # perf-doctor verdict for the window (observability.doctor): the
     # engine's steady signals with this window's columns layered on top
     merged = {k: v for k, v in st.items()
@@ -430,6 +455,7 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     preemptions = 0
     pq = ph = 0
     spec_committed = spec_slot_ticks = 0
+    moe_assigned = moe_dropped = 0.0
     tick_ms: List[Optional[float]] = []
     for r, snap, pc, pcs0 in zip(replicas, t_snaps, pcs, pc_snaps):
         t1 = r._timings
@@ -447,6 +473,10 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
         spec_committed += t1["spec_tokens_committed"] - \
             snap["spec_tokens_committed"]
         spec_slot_ticks += t1["spec_slot_ticks"] - snap["spec_slot_ticks"]
+        moe_assigned += (t1.get("moe_assigned_tokens", 0.0)
+                         - snap.get("moe_assigned_tokens", 0.0))
+        moe_dropped += (t1.get("moe_dropped_tokens", 0.0)
+                        - snap.get("moe_dropped_tokens", 0.0))
         if pcs0 is not None:
             pq += pc.queries - pcs0[0]
             ph += pc.hit_queries - pcs0[1]
@@ -481,6 +511,11 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
     if spec_slot_ticks:
         report["accepted_tokens_per_tick"] = round(
             spec_committed / spec_slot_ticks, 3)
+    if moe_assigned:
+        # fleet-aggregate expert balance (ISSUE 19): routed-token and
+        # overflow totals summed over the window across replicas
+        report["moe_assigned_tokens"] = round(moe_assigned, 1)
+        report["moe_dropped_rate"] = round(moe_dropped / moe_assigned, 4)
     # straggler verdict: per-replica tick-time skew vs the fleet median
     # (observability.watchdog; PADDLE_TPU_STRAGGLER_FACTOR) — a routed
     # fleet is only as fast as its slowest member, so the report says

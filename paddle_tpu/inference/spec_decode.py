@@ -68,6 +68,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..distributed import moe as _moe
 from ..func import functional_apply, functional_state
 from ..models.gpt import StaticKVCache
 
@@ -300,8 +301,14 @@ class SpecDecoder:
         idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
         t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
         window = jnp.concatenate([t0, drafts], axis=1)     # [B, K+1]
-        logits_t, t_cache = functional_apply(
-            self.engine.model, "verify_step", t_params, window, t_cache)
+        # expert-stats scope (ISSUE 19): the collector brackets only
+        # the TARGET verify — a MoE draft (possibly with a different
+        # expert count) must not fold into the target's load histogram
+        with _moe.collect_expert_stats() as b:
+            logits_t, t_cache = functional_apply(
+                self.engine.model, "verify_step", t_params, window,
+                t_cache)
+        moe = _moe.fold_expert_stats(b)
         toks, n_acc, n_emit, key = self._accept(
             drafts, q, logits_t, active, key, temps, top_ps)
         t_cache = StaticKVCache(
@@ -310,7 +317,7 @@ class SpecDecoder:
             t_cache.k_scale, t_cache.v_scale)
         d_cache = self._draft_rollback(d_cache, n_acc, active)
         out = jnp.concatenate([toks, n_emit[:, None]], axis=1)
-        return out, key, t_cache, d_cache
+        return out, key, t_cache, d_cache, moe
 
     def _tick_paged_fn(self, t_params, d_params, t_cache, d_cache,
                        last_win, nprev, active, tables, t_lens, key,
@@ -324,14 +331,16 @@ class SpecDecoder:
         idx = jnp.maximum(nprev.astype(jnp.int32) - 1, 0)
         t0 = jnp.take_along_axis(last_win, idx[:, None], axis=1)
         window = jnp.concatenate([t0, drafts], axis=1)
-        logits_t, t_cache = functional_apply(
-            self.engine.model, "verify_step_paged", t_params, window,
-            t_cache, tables, t_lens)
+        with _moe.collect_expert_stats() as b:
+            logits_t, t_cache = functional_apply(
+                self.engine.model, "verify_step_paged", t_params, window,
+                t_cache, tables, t_lens)
+        moe = _moe.fold_expert_stats(b)
         toks, n_acc, n_emit, key = self._accept(
             drafts, q, logits_t, active, key, temps, top_ps)
         d_cache = self._draft_rollback(d_cache, n_acc, active)
         out = jnp.concatenate([toks, n_emit[:, None]], axis=1)
-        return out, key, t_cache, d_cache
+        return out, key, t_cache, d_cache, moe
 
     # ---- host-side hooks the engine calls -----------------------------
     def on_admit(self, req, slot: int, first_tok: int):
@@ -372,16 +381,18 @@ class SpecDecoder:
         self.win[slot, :len(tail)] = tail
         self.nprev[slot] = len(tail)
 
-    def tick(self, active: np.ndarray):
+    def tick(self, active: np.ndarray, accum_moe: bool = True):
         """Run one spec tick over the current slots; returns the host
         readback ``out [B, K+2]`` (K+1 committed-stream tokens +
         committed count per slot).  The engine's PRNG key threads
         through the tick (sampled acceptance + residual draws) and
         advances exactly once per tick, so a seeded engine replays the
-        same stream."""
+        same stream.  ``accum_moe=False`` (warmup) discards the tick's
+        expert-load fold — throwaway tokens stay out of the balance
+        stats."""
         eng = self.engine
         if eng.kv_layout == "paged":
-            out, key, t_cache, d_cache = eng._timed_exec(
+            out, key, t_cache, d_cache, moe = eng._timed_exec(
                 "decode_ms", ("spec_tick", 0), self._tick_paged_jit,
                 eng.params, self.draft_params, eng.cache,
                 self.draft_cache, jnp.asarray(self.win),
@@ -391,7 +402,7 @@ class SpecDecoder:
                 eng._key, jnp.asarray(eng._temps),
                 jnp.asarray(eng._top_ps))
         else:
-            out, key, t_cache, d_cache = eng._timed_exec(
+            out, key, t_cache, d_cache, moe = eng._timed_exec(
                 "decode_ms", ("spec_tick", 0), self._tick_dense_jit,
                 eng.params, self.draft_params, eng.cache,
                 self.draft_cache, jnp.asarray(self.win),
@@ -401,6 +412,8 @@ class SpecDecoder:
         eng._key = key
         eng.cache = t_cache
         self.draft_cache = d_cache
+        if accum_moe:
+            eng._accum_moe(moe)
         return out
 
     def step_hbm_bytes(self) -> int:
@@ -432,13 +445,21 @@ class SpecDecoder:
                 np.int32(0), np.int32(1))
             self.draft_cache = cache
         active = np.zeros(eng.batch_slots, np.int32)
-        self.tick(active)
+        self.tick(active, accum_moe=False)
+        # reset lengths COMMITTED to the serving mesh, exactly like
+        # engine._warmup_dense: an uncommitted zeros operand is a
+        # different jit cache key than the committed one the warmup
+        # trace used, and the first real prefill would recompile
+        zeros = jnp.zeros((eng.batch_slots,), jnp.int32)
+        if eng.mesh is not None:
+            try:
+                zeros = eng._put(eng.mesh, zeros, ("dp",))
+            except Exception as e:
+                eng._shard_failed("spec_warmup_lengths", e)
         self.draft_cache = StaticKVCache(
-            self.draft_cache.k, self.draft_cache.v,
-            jnp.zeros((eng.batch_slots,), jnp.int32),
+            self.draft_cache.k, self.draft_cache.v, zeros,
             self.draft_cache.k_scale, self.draft_cache.v_scale)
         if eng.kv_layout != "paged":
             eng.cache = StaticKVCache(
-                eng.cache.k, eng.cache.v,
-                jnp.zeros((eng.batch_slots,), jnp.int32),
+                eng.cache.k, eng.cache.v, zeros,
                 eng.cache.k_scale, eng.cache.v_scale)

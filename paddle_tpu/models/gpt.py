@@ -170,10 +170,14 @@ class GPTConfig:
                 # parallel linears — they would silently stay full
                 # precision while bench reported quantize='int8'
                 raise NotImplementedError(
-                    "quantize with MoE is not supported: expert FFN "
-                    "matmuls (the dominant MoE FLOPs) have no quantized "
-                    "path yet, and quantizing only attention would "
-                    "misattribute the measured MFU")
+                    f"quantize={self.quantize!r} COMPUTE with MoE is "
+                    f"not supported: expert FFN matmuls (the dominant "
+                    f"MoE FLOPs) have no quantized path yet, and "
+                    f"quantizing only attention would misattribute the "
+                    f"measured MFU. Quantized KV CACHES are orthogonal "
+                    f"and do work with MoE engines — pass "
+                    f"kv_dtype='int8' to InferenceEngine/init_kv_cache "
+                    f"instead")
 
     def is_moe_layer(self, layer_idx: int) -> bool:
         return (self.moe_num_experts > 0 and
@@ -990,8 +994,10 @@ class GPTModel(Layer):
             if self.cfg.moe_num_experts > 0:
                 raise NotImplementedError(
                     "enable_quantize on a MoE model is not supported: "
-                    "expert FFN matmuls have no quantized path yet "
-                    "(see GPTConfig.quantize)")
+                    "expert FFN matmuls have no quantized COMPUTE path "
+                    "yet (see GPTConfig.quantize). Quantized KV caches "
+                    "are orthogonal and do work — pass kv_dtype='int8' "
+                    "to InferenceEngine/init_kv_cache instead")
         self.cfg = replace(self.cfg, quantize=mode)
         for blk in self.blocks:
             for lin in (blk.attn.qkv_proj, blk.attn.out_proj):

@@ -47,6 +47,12 @@ SPEC_ACCEPTANCE_MIN = 0.3
 PREFIX_HIT_RATE_MIN = 0.15
 PREFIX_QUERIES_MIN = 20
 SLOT_OCCUPANCY_MIN = 0.5
+# expert-parallel MoE serving (ISSUE 19): capacity-overflow drop rate
+# and max/mean expert-load skew past these read as imbalance; the rule
+# stays silent until real routed traffic backs the window
+MOE_DROP_RATE_MAX = 0.05
+MOE_LOAD_SKEW_MAX = 2.0
+MOE_ASSIGNED_MIN = 64.0
 # roofline/ledger rules (exec registry evidence, ISSUE 15)
 HBM_BW_FRAC_MIN = 0.5      # decode pushing >= half the HBM roof
 # multi-slice (DCN) tier rules
@@ -385,6 +391,51 @@ def _oom_action(s: dict, ev: dict) -> dict:
             "candidates": ["full", "dots"]}
 
 
+def _expert_imbalance(s: dict):
+    """MoE serving routes tokens badly: capacity overflow is DROPPING
+    token→expert assignments (quality loss — the dropped token skips
+    its expert FFN), or the hottest expert carries a multiple of the
+    mean load (its device bounds every a2a round-trip while the cold
+    experts idle).  Evidence only on real traffic."""
+    n_exp = _num(s, "moe_num_experts")
+    assigned = _num(s, "moe_assigned_tokens")
+    if not n_exp or assigned is None or assigned < MOE_ASSIGNED_MIN:
+        return None
+    drop = _num(s, "moe_dropped_rate") or 0.0
+    skew = _num(s, "moe_load_skew")
+    if drop < MOE_DROP_RATE_MAX and \
+            (skew is None or skew < MOE_LOAD_SKEW_MAX):
+        return None
+    ev = {"moe_dropped_rate": round(drop, 4),
+          "moe_num_experts": int(n_exp),
+          "moe_assigned_tokens": round(assigned, 1)}
+    if skew is not None:
+        ev["moe_load_skew"] = round(skew, 3)
+    ep = _num(s, "ep")
+    if ep and ep > 1:
+        ev["ep"] = int(ep)
+    load = s.get("moe_expert_load")
+    if isinstance(load, (list, tuple)) and load:
+        ev["hottest_expert"] = max(range(len(load)),
+                                   key=lambda i: load[i])
+    score = max(drop / MOE_DROP_RATE_MAX,
+                (skew or 0.0) / MOE_LOAD_SKEW_MAX) * 0.5
+    return ev, min(score, 1.0)
+
+
+def _moe_imbalance_action(s: dict, ev: dict) -> dict:
+    """Overflow drops → more room per expert (capacity factor above
+    the training default).  Pure skew with speculative decoding on →
+    shrink the verify burst first (spec_k multiplies the tokens a hot
+    expert sees per tick); otherwise the capacity raise still buys
+    headroom for the hot expert."""
+    if ev.get("moe_dropped_rate", 0.0) < MOE_DROP_RATE_MAX \
+            and s.get("spec_k"):
+        return _spec_k_action(s, ev)
+    return {"op": None, "param": "moe_capacity_factor", "env": None,
+            "candidates": [1.5, 2.0, 2.5]}
+
+
 def _slice_unhealthy(s: dict):
     """A DCN slice's heartbeat is stale (past half its timeout) or
     already declared dead — the membership layer is about to (or did)
@@ -514,6 +565,12 @@ RULES: List[Rule] = [
          _idle_slots,
          action={"op": None, "param": "batch_slots",
                  "env": "PADDLE_TPU_DECODE_SLOTS", "candidates": []}),
+    Rule("expert-imbalance", ("serve",),
+         "raise moe_capacity_factor (GPTConfig) so the capacity "
+         "buckets stop dropping assignments / lower spec_k "
+         "(PADDLE_TPU_SPEC_K) to shrink the verify burst a hot expert "
+         "absorbs / rebalance gating (aux loss weight) upstream",
+         _expert_imbalance, action=_moe_imbalance_action),
     Rule("bandwidth-bound-decode", ("serve",),
          "enable the decode megakernel (PADDLE_TPU_DECODE_MEGAKERNEL=1)"
          " / int8 KV (PADDLE_TPU_KV_DTYPE=int8) / speculative decoding "

@@ -27,7 +27,7 @@ import numpy as np
 
 __all__ = ["run_zero3_phase", "run_1f1b_phase", "run_moe_a2a_phase",
            "run_elastic_restore_phase", "run_dcn_phase",
-           "run_serve_tp_phase", "PARITY_RTOL"]
+           "run_serve_tp_phase", "run_serve_ep_phase", "PARITY_RTOL"]
 
 # fp32 loss parity between a schedule and its synchronous counterpart
 PARITY_RTOL = 1e-5
@@ -488,6 +488,107 @@ def run_serve_tp_phase(gen_tokens: int = 8) -> Dict:
         out["layouts"][layout] = {
             "tokens": sum(len(t) for t in tok2),
             "compiles_after_warmup": compiles,
+            "exec_entries_with_submesh": len(metas),
+        }
+    out["t_s"] = round(time.perf_counter() - t0, 1)
+    return out
+
+
+def run_serve_ep_phase(gen_tokens: int = 8) -> Dict:
+    """Expert-parallel MoE serving (ISSUE 19): an ep=2 serving mesh
+    must generate TOKEN-IDENTICAL output to the replicated ep=1 MoE
+    engine on BOTH KV layouts (the capacity a2a dispatch is an exact
+    reformulation of the dense one-hot combine, not an approximation),
+    stay recompile-free after warmup, halve the per-device expert-FFN
+    residency, carry 'ep' in the exec-registry meta, and attribute the
+    dispatch/combine all-to-all bytes to the ep axis in the collective
+    fold."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import exec_registry
+    from paddle_tpu.utils import compile_counter
+
+    t0 = time.perf_counter()
+    assert len(jax.devices()) >= 2, \
+        f"serve_ep phase needs >=2 devices, found {len(jax.devices())}"
+    cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64,
+                    use_flash_attention=False,
+                    moe_num_experts=4, moe_top_k=2)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 96, (n,)).astype(np.int32)
+               for n in (5, 7, 6)]
+
+    def run(layout, ep):
+        mesh = create_mesh({"dp": 1, "tp": 1, "ep": ep}) \
+            if ep > 1 else None
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        kw = dict(batch_slots=2, prefill_buckets=[16], mesh=mesh,
+                  kv_layout=layout)
+        if layout == "paged":
+            kw.update(kv_block_size=8, kv_num_blocks=24)
+        eng = InferenceEngine(m, **kw)
+        eng.warmup(buckets=[16])
+        snap = compile_counter.snapshot()
+        rids = [eng.add_request(p, max_new_tokens=gen_tokens)
+                for p in prompts]
+        toks = eng.run()
+        return ([list(map(int, toks[r])) for r in rids],
+                snap.new_compiles, eng)
+
+    out: Dict = {"name": "serve_ep", "layouts": {}}
+    for layout in ("dense", "paged"):
+        base, _, eng1 = run(layout, 1)
+        tok2, compiles, eng = run(layout, 2)
+        assert tok2 == base, (
+            f"serve ep=2 ({layout}): tokens diverged from ep=1\n"
+            f"  ep=1: {base}\n  ep=2: {tok2}")
+        assert compiles == 0, (
+            f"serve ep=2 ({layout}): {compiles} XLA compiles after "
+            f"warmup (the capacity a2a dispatch is not shape-stable)")
+        s1, s2 = eng1.stats, eng.stats
+        assert s2["ep"] == 2 and s2["moe_num_experts"] == 4
+        assert s2["moe_expert_load"] == s1["moe_expert_load"], (
+            f"serve ep=2 ({layout}): expert load histogram diverged\n"
+            f"  ep=1: {s1['moe_expert_load']}\n"
+            f"  ep=2: {s2['moe_expert_load']}")
+        # per-device expert-FFN residency must drop ~ep× vs replicated
+        b1 = eng1._moe_expert_bytes_per_device()
+        b2 = eng._moe_expert_bytes_per_device()
+        assert b2 * 2 == b1, \
+            f"expert bytes/device not halved under ep=2: {b1} -> {b2}"
+        metas = [e.meta for e in
+                 exec_registry.registry().entries(eng._exec_component)
+                 if e.meta.get("submesh")]
+        assert metas, \
+            f"serve ep=2 ({layout}): no exec entries carry submesh meta"
+        for meta in metas:
+            assert meta.get("ep") == 2, f"ep meta wrong: {meta}"
+            assert meta["submesh"]["shape"].get("ep") == 2, \
+                f"submesh shape wrong: {meta}"
+        # the collective fold must attribute the MoE dispatch/combine
+        # all-to-all to the 'ep' axis on the decode executable
+        reg = exec_registry.registry()
+        reg.analyze_all(eng._exec_component)
+        rows = [r for r in reg.snapshot(
+                    eng._exec_component)["executables"]
+                if r["kind"] == "decode" and r["analyzed"]]
+        assert rows, f"serve ep=2 ({layout}): no analyzed decode rows"
+        ep_colls = [r for r in rows
+                    if (r.get("collectives") or {})
+                    .get("by_axis", {}).get("ep", {}).get("count", 0)]
+        assert ep_colls, (
+            f"serve ep=2 ({layout}): no decode executable attributes "
+            f"collective bytes to the ep axis")
+        out["layouts"][layout] = {
+            "tokens": sum(len(t) for t in tok2),
+            "compiles_after_warmup": compiles,
+            "expert_bytes_per_device": b2,
+            "moe_dropped_rate": s2["moe_dropped_rate"],
             "exec_entries_with_submesh": len(metas),
         }
     out["t_s"] = round(time.perf_counter() - t0, 1)

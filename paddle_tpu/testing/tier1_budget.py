@@ -1,0 +1,122 @@
+"""Tier-1 wall-budget guard (ISSUE 19 satellite).
+
+The tier-1 suite runs under one hard wall budget (ROADMAP: 870s for
+``pytest -m 'not slow'``).  Every PR adds tests, and the historical
+failure mode is silent: a new test file's fast lane costs 90s, nobody
+notices, and three PRs later the suite times out under ``timeout -k``
+mid-file.  This module keeps the budget honest with three small
+pieces:
+
+* a PURE decision function — :func:`files_over_budget` — that maps
+  ``{test file: fast-lane seconds}`` to the offenders over the
+  per-file budget (``PADDLE_TPU_TIER1_FILE_BUDGET_S``, default 60s),
+  minus explicit exemptions (``PADDLE_TPU_TIER1_EXEMPT``, comma list);
+* a recorded-durations file (``tests/.tier1_durations.json``) that the
+  opt-in conftest hook (``PADDLE_TPU_TIER1_AUTOSPLIT=1``) writes after
+  a suite run and reads at collection: a file recorded OVER budget has
+  its unmarked tests auto-promoted to the slow lane on the next run —
+  the suite self-heals instead of timing out;
+* :func:`check_recorded_durations`, the ``bench.py --smoke`` phase:
+  fail the smoke when the recorded split has drifted over budget, so
+  the drift is a red bench before it is a timed-out CI lane.
+
+Everything here is stdlib-only and import-safe under any backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["files_over_budget", "load_durations", "record_durations",
+           "check_recorded_durations", "durations_path",
+           "DEFAULT_FILE_BUDGET_S"]
+
+DEFAULT_FILE_BUDGET_S = 60.0
+DURATIONS_BASENAME = ".tier1_durations.json"
+
+
+def _budget_s() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_TIER1_FILE_BUDGET_S",
+                                    DEFAULT_FILE_BUDGET_S))
+    except ValueError:
+        return DEFAULT_FILE_BUDGET_S
+
+
+def _exempt() -> List[str]:
+    raw = os.environ.get("PADDLE_TPU_TIER1_EXEMPT", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def files_over_budget(durations: Dict[str, float],
+                      budget_s: Optional[float] = None,
+                      exempt: Optional[List[str]] = None
+                      ) -> List[Tuple[str, float]]:
+    """The decision function, pure so tests can drive it directly:
+    which files' recorded FAST-LANE (non-slow) wall time exceeds the
+    per-file budget?  ``exempt`` entries match by basename or exact
+    path.  Returns ``[(file, seconds), ...]`` sorted worst-first."""
+    budget = _budget_s() if budget_s is None else float(budget_s)
+    exempt = _exempt() if exempt is None else list(exempt)
+
+    def _exempted(f: str) -> bool:
+        base = os.path.basename(f)
+        return f in exempt or base in exempt
+
+    out = [(f, float(s)) for f, s in durations.items()
+           if isinstance(s, (int, float)) and float(s) > budget
+           and not _exempted(f)]
+    out.sort(key=lambda fs: -fs[1])
+    return out
+
+
+def durations_path(tests_dir: Optional[str] = None) -> str:
+    """Default location: ``tests/.tier1_durations.json`` next to this
+    repo's suite (the conftest passes its own directory)."""
+    if tests_dir is None:
+        tests_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tests")
+    return os.path.join(tests_dir, DURATIONS_BASENAME)
+
+
+def load_durations(path: Optional[str] = None) -> Optional[Dict[str, float]]:
+    """The recorded per-file fast-lane durations, or None when no run
+    has recorded them yet (a fresh clone must not fail anything)."""
+    path = path or durations_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    files = data.get("files") if isinstance(data, dict) else None
+    if not isinstance(files, dict):
+        return None
+    return {str(k): float(v) for k, v in files.items()
+            if isinstance(v, (int, float))}
+
+
+def record_durations(durations: Dict[str, float],
+                     path: Optional[str] = None) -> str:
+    """Persist one run's per-file fast-lane durations (overwrites —
+    the file describes the LAST recorded run, not a rolling mean)."""
+    path = path or durations_path()
+    payload = {"budget_s": _budget_s(),
+               "files": {k: round(float(v), 3)
+                         for k, v in sorted(durations.items())}}
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_recorded_durations(path: Optional[str] = None
+                             ) -> Optional[dict]:
+    """The bench --smoke verdict: ``None`` when nothing is recorded,
+    else ``{"budget_s", "files", "over_budget": [(file, s), ...]}``."""
+    durations = load_durations(path)
+    if durations is None:
+        return None
+    return {"budget_s": _budget_s(), "files": len(durations),
+            "over_budget": files_over_budget(durations)}

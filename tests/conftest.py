@@ -64,3 +64,59 @@ def _seed_everything():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# ---- tier-1 wall-budget guard (opt-in: PADDLE_TPU_TIER1_AUTOSPLIT=1) ----
+#
+# The fast lane (-m 'not slow') runs under one hard timeout (ROADMAP's
+# 870s); a single overgrown test file can push the whole suite past it.
+# With autosplit on, each run records per-file fast-lane wall time to
+# tests/.tier1_durations.json, and at collection any file whose LAST
+# recorded fast lane exceeded the per-file budget (~60s,
+# PADDLE_TPU_TIER1_FILE_BUDGET_S) has its unmarked tests auto-promoted
+# to the slow lane — the suite self-heals instead of timing out.
+# bench.py --smoke reads the same recording and goes red on drift, so
+# the promotion never hides silently.  Off by default: the default
+# tier-1 collection is byte-identical to a repo without this hook.
+
+_AUTOSPLIT = os.environ.get("PADDLE_TPU_TIER1_AUTOSPLIT", "") == "1"
+_T1_DURATIONS: dict = {}
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _AUTOSPLIT:
+        return
+    from paddle_tpu.testing import tier1_budget
+    recorded = tier1_budget.load_durations()
+    if not recorded:
+        return
+    over = {f for f, _ in tier1_budget.files_over_budget(recorded)}
+    if not over:
+        return
+    slow = pytest.mark.slow
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        if fname in over and item.get_closest_marker("slow") is None:
+            item.add_marker(slow)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    if not _AUTOSPLIT or item.get_closest_marker("slow") is not None:
+        yield
+        return
+    import time
+    t0 = time.perf_counter()
+    yield
+    fname = os.path.basename(str(item.fspath))
+    _T1_DURATIONS[fname] = (_T1_DURATIONS.get(fname, 0.0)
+                            + time.perf_counter() - t0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _AUTOSPLIT or not _T1_DURATIONS:
+        return
+    from paddle_tpu.testing import tier1_budget
+    tier1_budget.record_durations(
+        _T1_DURATIONS,
+        tier1_budget.durations_path(os.path.dirname(__file__)))

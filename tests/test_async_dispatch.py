@@ -438,6 +438,7 @@ def test_autotune_cache_env_off(monkeypatch):
 # ---------------------------------------------------------------------------
 # bench --smoke: the dispatch-path contract, end to end
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_bench_smoke_contract():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run([sys.executable, "bench.py", "--smoke"], cwd=REPO,

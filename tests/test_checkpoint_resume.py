@@ -83,6 +83,7 @@ def test_checkpoint_restores_onto_different_mesh(tmp_path):
     np.testing.assert_allclose(rest_b, rest_a, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gradient_merge_buffer_checkpointed(tmp_path):
     """Mid-accumulation kill: the grad-merge buffer rides the
     checkpoint so the k-step window continues, not restarts."""
@@ -101,6 +102,7 @@ def test_gradient_merge_buffer_checkpointed(tmp_path):
     np.testing.assert_allclose(resumed, full[2:], rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpipe_trainer_save_load(tmp_path):
     from paddle_tpu.distributed.pipeline import GPipeTrainer
     from paddle_tpu.models.gpt import gpt_pipeline_parts

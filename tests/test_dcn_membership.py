@@ -316,6 +316,7 @@ def _gpt_batches(n=6):
     return [(b, np.roll(b, -1, 1).astype(np.int64)) for b in ids]
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_hierarchical_matches_flat_dp():
     data = _gpt_batches(3)
     flat = _gpt_trainer(create_mesh({"dp": 8}))
@@ -327,6 +328,7 @@ def test_hierarchical_matches_flat_dp():
     assert hier.stats["dcn_slices"] == 2
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_slice_loss_reforms_in_memory_with_parity(monkeypatch):
     from paddle_tpu.utils import compile_counter
     data = _gpt_batches(6)

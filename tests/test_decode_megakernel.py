@@ -231,6 +231,7 @@ def test_megakernel_matches_composed_paged_engine(model, kv_dtype):
     assert run(False) == run(True)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_megakernel_matches_composed_quantized_compute(model):
     """With int8 COMPUTE (cfg.quantize) the fused op routes its
     composite, whose projections run ops.quantized_matmul — logits must
@@ -256,6 +257,7 @@ def test_megakernel_matches_composed_quantized_compute(model):
                                rtol=0)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_megakernel_interpret_kernel_in_model():
     """The REAL Pallas kernel (interpret mode) inside the model decode
     step matches the composed path — kernel-compatible shapes (h=128,
@@ -389,13 +391,16 @@ def test_bench_resume_serve_rows(tmp_path, monkeypatch):
            "gen_tokens": 64, "value": 900.0}
     bench._persist_row(row, kind="serve")
     measured = bench._measured_rows("serve")
-    # tp joined the candidate key (ISSUE 18): a row without the column
-    # resumes as the tp=1 candidate, a tp=2 row is a DIFFERENT point
-    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1)
+    # tp (ISSUE 18) and ep (ISSUE 19) joined the candidate key: a row
+    # without the columns resumes as the tp=1/ep=1 candidate, a tp=2
+    # or ep=2 row is a DIFFERENT point
+    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 1)
     assert key in measured and measured[key]["value"] == 900.0
-    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64, 1) \
+    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64, 1, 1) \
         not in measured
-    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 2) \
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 2, 1) \
+        not in measured
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 2) \
         not in measured
 
 

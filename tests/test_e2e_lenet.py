@@ -22,6 +22,7 @@ def data():
 
 
 class TestLeNetE2E:
+    @pytest.mark.slow  # tier-1 wall budget: heaviest in file
     def test_fit_evaluate_predict_save_load(self, data, tmp_path):
         train, test = data
         paddle.seed(42)
@@ -77,7 +78,8 @@ class TestLeNetE2E:
 class TestModelZoo:
     @pytest.mark.parametrize("ctor,ch,sz,n", [
         (lambda: vision.resnet18(num_classes=7), 3, 32, 7),
-        (lambda: vision.mobilenet_v2(num_classes=5), 3, 32, 5),
+        pytest.param(lambda: vision.mobilenet_v2(num_classes=5), 3, 32, 5,
+                     marks=pytest.mark.slow),  # tier-1 wall budget
     ])
     def test_forward_shapes(self, ctor, ch, sz, n):
         m = ctor()
@@ -98,6 +100,7 @@ class TestModelZoo:
             np.random.randn(1, 3, 224, 224).astype(np.float32))
         assert m(x).shape == [1, 10]
 
+    @pytest.mark.slow  # tier-1 wall budget: heaviest in file
     def test_train_resnet_step(self):
         m = vision.resnet18(num_classes=4)
         o = opt.Momentum(0.01, parameters=m.parameters())

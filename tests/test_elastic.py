@@ -206,6 +206,7 @@ def test_zero3_stage3_repartition_on_shrink(tmp_path):
     np.testing.assert_allclose(res, ref_losses[3:], rtol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_pipeline_restore_pp4_to_pp2(tmp_path):
     """GPipeTrainer pp=4 -> pp=2: the stacked [L, ...] slabs re-split
     over the new pp extent (each rank's stage param group doubles),
@@ -507,6 +508,7 @@ def _losses_from(stdout):
             for line in stdout.splitlines() if line.startswith("LOSS")]
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_subprocess_dp8_kill_resumes_on_dp4(tmp_path):
     """The acceptance run: a dp=8 trainer is SIGTERM-killed mid-run by
     the fault harness, drains + checkpoints, and a second process that

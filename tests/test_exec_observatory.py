@@ -76,6 +76,7 @@ def test_engine_executables_join_registry_at_compile_time():
     assert dec.in_shardings        # non-empty summary
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_spec_and_paged_kinds_registered():
     m = tiny_model()
     eng = InferenceEngine(m, batch_slots=2, kv_layout="paged",
@@ -433,6 +434,7 @@ def test_ledger_oom_flag_agrees_with_doctor_threshold(monkeypatch):
     assert doctor.diagnose({"hbm": snap})[0]["bottleneck"] == "oom-risk"
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_engine_registered_donation_matches_jit_construction():
     m = tiny_model()
     eng = InferenceEngine(m, batch_slots=2, kv_layout="paged",

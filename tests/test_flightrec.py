@@ -450,6 +450,7 @@ def test_doctor_field_rides_trainer_and_engine_stats():
     json.dumps(eng.stats["doctor"])
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_doctor_and_straggler_in_loadgen_reports():
     from paddle_tpu.inference.loadgen import (MultiTenantWorkload,
                                               SharedPrefixWorkload,
@@ -549,6 +550,7 @@ def _run_child(tmp_path, mode, extra_env):
     return p, str(tmp_path / "black_box")
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_subprocess_sigterm_leaves_valid_bundle(tmp_path):
     """A trainer killed mid-run by the fault harness's SIGTERM leaves
     an explainable black box: valid bundle JSON, validating Chrome

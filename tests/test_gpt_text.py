@@ -48,6 +48,7 @@ def test_gpt_gqa_forward():
     assert logits.shape == [2, 8, 64]
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_tp_matches_dp():
     ids, labels = batch()
     crit = GPTPretrainingCriterion()
@@ -66,6 +67,7 @@ def test_gpt_tp_matches_dp():
     np.testing.assert_allclose(tp, dp, rtol=2e-3, atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_recompute_matches_plain():
     ids, labels = batch()
     crit = GPTPretrainingCriterion()

@@ -44,6 +44,7 @@ def _losses_from_fit(model, data, epochs=2, bs=16):
     return seen
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_lenet_fit_compiled_matches_eager():
     data = _Digits()
 

@@ -363,6 +363,7 @@ def wiring_model():
     return tiny_model(vocab_size=50, hidden_size=32, num_heads=2)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_greedy_search_matches_naive(wiring_model):
     from paddle_tpu.text import greedy_search, gpt_step_fn
     m = wiring_model

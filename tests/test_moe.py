@@ -238,6 +238,7 @@ def test_gpt_moe_with_recompute():
     assert np.isfinite(l0) and l1 < l0
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_moe_pipeline_aux_flows():
     """MoE blocks under GPipeTrainer: the router aux loss reaches the
     training loss (gate weights receive gradient and move)."""
@@ -269,6 +270,7 @@ def test_gpt_moe_pipeline_aux_flows():
     assert np.any(g0 != g1), "router gate got no gradient under pipeline"
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_moe_pipeline_loss_includes_aux():
     """Pipeline loss parity with SpmdTrainer for an MoE model on the
     FIRST step (same params, same batch): both must include the router

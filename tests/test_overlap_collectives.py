@@ -189,6 +189,7 @@ def _zero3_trainer(overlap, dp8_mesh, seed=7, comm=False):
                        mesh=dp8_mesh, strategy=st, comm_stats=comm)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_zero3_overlap_matches_sync_and_recompile_free(dp8_mesh,
                                                        gpt_batch):
     """The tentpole contract: overlapped ZeRO-3 losses == synchronous
@@ -251,6 +252,7 @@ def _pipe(schedule, mesh, num_micro, seed=0, n_blocks=2, comm=False):
                         schedule=schedule, comm_stats=comm)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_1f1b_matches_gpipe_and_recompile_free(pp2_mesh):
     """1F1B loss parity vs GPipe at pp=2, M=8 (the acceptance config),
     zero recompiles across steps 2..N, and comm fields reported."""

@@ -450,6 +450,7 @@ def test_prefix_clamped_when_padded_extent_overflows_table(model):
 
 # ---- loadgen + bench wiring (the CI smoke satellite) -------------------
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_bench_loadtest_smoke_contract():
     """`python bench.py --serve --loadtest --smoke` end to end: a few
     dozen Poisson arrivals with shared-prefix prompts, asserting inside

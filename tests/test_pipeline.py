@@ -243,6 +243,7 @@ def test_dedupe_head_cuts_compiled_flops():
     assert f_dedupe < 0.7 * f_masked, (f_dedupe, f_masked)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_dedupe_head_parity():
     """Deduped head computes the same losses as the masked fallback."""
     rng = np.random.RandomState(1)
@@ -255,6 +256,7 @@ def test_dedupe_head_parity():
     np.testing.assert_allclose(la, lb, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_dedupe_head_falls_back_when_not_divisible():
     """M=6 not divisible by pp=4: trainer quietly uses the masked head."""
     pipe = _head_pipe(True, M=6, seed=9)

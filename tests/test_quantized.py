@@ -209,7 +209,10 @@ def test_quantized_training_and_strategy_qat():
 # ---------------------------------------------------------------------------
 # int8 KV cache: static layout
 # ---------------------------------------------------------------------------
-@pytest.mark.parametrize("kv_heads", [None, 2])
+@pytest.mark.parametrize("kv_heads", [
+    None,
+    # tier-1 wall budget: GQA variant rides the slow lane
+    pytest.param(2, marks=pytest.mark.slow)])
 def test_int8_kv_decode_tracks_dense_static(model, kv_heads):
     """prefill + teacher-forced decode over an int8 StaticKVCache stays
     within quantization tolerance of the full forward at every step
@@ -239,7 +242,10 @@ def test_int8_kv_decode_tracks_dense_static(model, kv_heads):
 # ---------------------------------------------------------------------------
 # int8 KV cache: paged layout
 # ---------------------------------------------------------------------------
-@pytest.mark.parametrize("kv_heads", [None, 2])
+@pytest.mark.parametrize("kv_heads", [
+    None,
+    # tier-1 wall budget: GQA variant rides the slow lane
+    pytest.param(2, marks=pytest.mark.slow)])
 def test_int8_kv_decode_tracks_dense_paged(model, kv_heads):
     """Same contract over a paged int8 pool: manual block tables, cold
     prefill + teacher-forced paged decode vs the full forward."""

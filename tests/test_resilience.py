@@ -559,7 +559,10 @@ def _fit(m, epochs, save_dir=None, auto_resume=False, callbacks=None):
     return seen
 
 
-@pytest.mark.parametrize("compiled", [True, False])
+@pytest.mark.parametrize("compiled", [
+    pytest.param(True, marks=pytest.mark.slow),  # tier-1 wall budget
+    False,
+])
 def test_fit_sigterm_mid_epoch_resumes_exactly(tmp_path, compiled):
     """Kill-and-resume e2e: SIGTERM lands mid-epoch (after global batch
     3 of 6), fit drains the step, checkpoints the mid-epoch position,
@@ -639,6 +642,7 @@ print("DONE", tr._step_count, flush=True)
 """
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_subprocess_sigterm_kill_and_resume(tmp_path):
     """True preemption: the child delivers itself SIGTERM mid-train
     (deterministically, via the fault harness), exits 0 after a final

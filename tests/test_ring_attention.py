@@ -67,6 +67,7 @@ def test_ring_backward_matches_reference():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_sequence_parallel_training_parity():
     """GPT with sequence_parallel=True on a dp2 x sp4 mesh: compiled
     train-step losses match the single-device dense run (the sp layout
@@ -117,6 +118,7 @@ def test_gpt_sp_flag_without_mesh_falls_back():
     assert np.all(np.isfinite(np.asarray(out.data)))
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_ring_gqa_unexpanded_kv_matches_repeated():
     """GQA: k/v enter the ring with Hkv heads and rotate un-expanded;
     result equals dense attention on repeat_interleaved k/v."""
@@ -134,6 +136,7 @@ def test_ring_gqa_unexpanded_kv_matches_repeated():
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_gpt_sp_ragged_batch_falls_back_to_dense():
     """Review regression: a batch whose seq/batch doesn't divide the mesh
     must not crash the shard_map — it silently uses dense attention."""

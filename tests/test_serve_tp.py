@@ -126,6 +126,7 @@ def test_tp_paged_int8_churn_recompile_free(model):
     eng.check_leak_free()
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_tp_paged_gqa_parity():
     """GQA on the paged fp pool: 2 KV heads over tp=2 means ONE kv
     head per shard — the sharpest head-sharding corner."""
@@ -165,6 +166,7 @@ def test_tp_parity_matrix_full(model, layout, kv_dtype, spec):
 
 
 # ---- disaggregated prefill on disjoint device groups ------------------
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_disagg_disjoint_groups(model):
     """DistServe-style split: prefill compiles against devices [0:4],
     decode against [4:8], the KV handoff crosses the group boundary,

@@ -151,6 +151,7 @@ def test_router_end_to_end_results(model):
 
 # ---- fleet load harness -------------------------------------------------
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_fleet_loadtest_report_columns(model):
     """run_fleet_loadtest on a 2-replica fleet: per-replica columns,
     router hit rate, aggregate prefix hit rate, and zero recompiles in
@@ -242,6 +243,7 @@ def test_disagg_decode_steps_run_no_prefill(model):
     assert dis.stats["prefill_worker_prefills"] == 3
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_disagg_spec_and_prefix_cache_compose(model):
     """Disagg + spec decode + radix prefix cache all stack: shared
     prefixes hit across handoffs, spec ticks commit >1 token, output

@@ -181,6 +181,7 @@ def test_ring_rejects_oversized_frame():
         r.destroy()
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_dead_worker_detected_not_hang():
     """SIGKILLed worker (no close_writer) surfaces as RuntimeError via
     liveness polling instead of hanging the trainer."""

@@ -121,6 +121,7 @@ def _field(stdout, tag):
     raise AssertionError(f"{tag} line missing from child stdout")
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_subprocess_slice_loss_reforms_and_resumes(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(_SLICE_TRAIN)

@@ -223,9 +223,20 @@ def test_verify_step_matches_sequential(target, kv_dtype):
 
 # ---- engine level: the token-identity matrix ----------------------------
 
-@pytest.mark.parametrize("layout", ["dense", "paged"])
-@pytest.mark.parametrize("kv_dtype", [None, "int8"])
-@pytest.mark.parametrize("k", [1, 2, 4])
+# tier-1 wall budget: the fast lane keeps the 4 corners (k extremes ×
+# dtype × layout, every axis value covered); the 8 interior combos of
+# the k × dtype × layout cube ride the slow lane
+_MATRIX_CORNERS = {(1, None, "dense"), (1, "int8", "paged"),
+                   (4, None, "paged"), (4, "int8", "dense")}
+_MATRIX = [
+    pytest.param(k, kv, lay, id=f"{k}-{kv}-{lay}",
+                 marks=() if (k, kv, lay) in _MATRIX_CORNERS
+                 else pytest.mark.slow)
+    for k in (1, 2, 4) for kv in (None, "int8")
+    for lay in ("dense", "paged")]
+
+
+@pytest.mark.parametrize("k,kv_dtype,layout", _MATRIX)
 def test_spec_token_identity_matrix(target, draft, prompts, reference,
                                     layout, kv_dtype, k):
     """Greedy speculative output ≡ the non-speculative rollout across

@@ -430,6 +430,7 @@ def test_telemetry_on_adds_zero_syncs_and_zero_recompiles(layout, tracer):
     assert {"queued", "prefill", "decode"} <= req_spans
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_telemetry_on_spec_decode_zero_recompiles(tracer):
     """Spec engine (target-as-draft harness): spans on, one sync per
     spec tick, zero recompiles, accept counts in the tick args."""

@@ -43,6 +43,7 @@ def test_grid_sample_flip_and_zero_padding():
     np.testing.assert_allclose(out2, 0.0)
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_grid_sample_differentiable():
     x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32),
                          stop_gradient=False)
@@ -85,6 +86,7 @@ def test_roi_align_gradient_ramp():
         assert (out[:, j] < out[:, j + 1]).all()
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_roi_pool_takes_max():
     x = np.zeros((1, 1, 8, 8), np.float32)
     x[0, 0, 1, 1] = 9.0
@@ -99,6 +101,7 @@ def test_roi_pool_takes_max():
     assert out[0, 0, 0, 0] == out.max()
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_dice_and_npair_losses():
     probs = paddle.to_tensor(
         np.array([[[0.9, 0.1], [0.2, 0.8]]], np.float32))
@@ -175,6 +178,7 @@ def test_viterbi_decode_matches_brute_force():
         assert got == want_p, f"row {i}: {got} vs {want_p}"
 
 
+@pytest.mark.slow  # tier-1 wall budget: heaviest in file
 def test_roi_align_differentiable():
     """Review fix: roi_align must connect to autograd (a detection
     backbone trains through it)."""
