@@ -94,6 +94,12 @@ AXES: Dict[str, KnobAxis] = {a.name: a for a in [
              env="PADDLE_TPU_DECODE_SLOTS"),
     KnobAxis("prefix_cache", ("serve",), candidates=[True],
              env="PADDLE_TPU_PREFIX_CACHE"),
+    # chunked prefill (ISSUE 20): 0 disables; hot_apply via
+    # InferenceEngine.set_prefill_chunk — a host-side flag flip (the
+    # chunk executable for a NEW width compiles once, at apply time,
+    # not in the steady-state serving loop)
+    KnobAxis("prefill_chunk", ("serve",), candidates=[0, 32, 64, 128],
+             env="PADDLE_TPU_CHUNKED_PREFILL", hot_apply=True),
 ]}
 
 

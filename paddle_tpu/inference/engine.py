@@ -45,15 +45,33 @@ rollback) — the compile-cache guard plus no-donation keeps the test
 suite's warm cache safe.  On TPU, donation is on and the cache updates
 are true in-place writes.
 
+Chunked prefill (ISSUE 20, Agrawal et al., *Sarathi-Serve*): the
+monolithic bucketed prefill above runs BETWEEN decode ticks, so one
+long admission freezes every in-flight stream — the classic
+prefill/decode interference.  ``PADDLE_TPU_CHUNKED_PREFILL=<chunk>``
+(engine kwarg ``prefill_chunk=``) switches admission to a token
+budget: each tick advances every still-prefilling slot by up to
+``chunk`` prompt tokens total through ONE fixed-shape chunk executable
+(the PR-10 window-attention machinery with W = chunk), alongside —
+never instead of — the decode batch.  A slot GRADUATES to decode when
+its prompt completes; until then it is excluded from the decode/spec
+active set.  Inter-token latency at the tail is bounded by the chunk
+size instead of the longest prompt, throughput stays within noise
+(same tokens, same executables count), and the zero-recompile
+discipline survives because the chunk executable's shapes never
+change.  Greedy output is token-identical to unchunked across
+dense/paged × fp/int8 × GQA.
+
 Knobs: ``PADDLE_TPU_DECODE_SLOTS`` (default 8),
 ``PADDLE_TPU_PREFILL_BUCKETS`` (comma-separated lengths; default powers
 of two up to max_seq_len), ``PADDLE_TPU_KV_LAYOUT`` (dense|paged),
 ``PADDLE_TPU_KV_BLOCK_SIZE`` (default 128), ``PADDLE_TPU_KV_BLOCKS``
 (usable pool blocks; default = dense-equivalent memory),
-``PADDLE_TPU_PREFIX_CACHE`` (default on for paged), and
-``PADDLE_TPU_KV_DTYPE`` (int8|fp8; quantized KV storage with per-head
-scales dequantized inside the decode kernels — half the HBM bytes per
-step; default full precision).
+``PADDLE_TPU_PREFIX_CACHE`` (default on for paged),
+``PADDLE_TPU_CHUNKED_PREFILL`` (chunk size; 0 = monolithic prefill,
+the default), and ``PADDLE_TPU_KV_DTYPE`` (int8|fp8; quantized KV
+storage with per-head scales dequantized inside the decode kernels —
+half the HBM bytes per step; default full precision).
 """
 from __future__ import annotations
 
@@ -80,7 +98,8 @@ from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from ..observability import watchdog as _watchdog
 from ..utils import compile_cache, compile_counter
-from .paged_kv import BlockAllocator, blocks_for, init_paged_cache
+from .paged_kv import (BlockAllocator, blocks_for, blocks_to_extend,
+                       init_paged_cache)
 from .prefix_cache import RadixPrefixCache
 
 __all__ = ["InferenceEngine", "Request", "default_prefill_buckets"]
@@ -159,6 +178,15 @@ class Request:
         self.resume_prompt: Optional[np.ndarray] = None
         self.preemptions = 0
         self.admit_seq: Optional[int] = None
+        # chunked prefill (ISSUE 20): a slot holds its request while
+        # the prompt prefills chunk by chunk; `prefill_pos` is how many
+        # prompt tokens are in the cache, `prefilling` keeps the slot
+        # out of the decode/spec active set until graduation
+        self.prefill_pos = 0
+        self.prefilling = False
+        # per-token delivery timestamps (first token + every commit):
+        # the inter-token-latency record the load harness pools
+        self.token_times: List[float] = []
 
     def effective_prompt(self) -> np.ndarray:
         return self.prompt if self.resume_prompt is None \
@@ -202,7 +230,8 @@ class InferenceEngine:
                  kv_num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  kv_dtype: Optional[str] = None,
-                 spec_k: Optional[int] = None, draft_model=None):
+                 spec_k: Optional[int] = None, draft_model=None,
+                 prefill_chunk: Optional[int] = None):
         model.eval()
         self.model = model
         cfg = model.cfg
@@ -226,6 +255,17 @@ class InferenceEngine:
         # full-precision cache, the default and the parity oracle.
         from ..ops.quantized_matmul import resolve_kv_quant
         self.kv_dtype = resolve_kv_quant(kv_dtype)
+        # chunked prefill (ISSUE 20; env PADDLE_TPU_CHUNKED_PREFILL):
+        # 0/unset keeps the monolithic bucketed admission prefill
+        if prefill_chunk is None:
+            env = os.environ.get("PADDLE_TPU_CHUNKED_PREFILL",
+                                 "").strip()
+            prefill_chunk = int(env) if env else 0
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got "
+                             f"{self.prefill_chunk}")
+        self._chunked = self.prefill_chunk > 0
 
         # persistent compile cache: a restarted server deserializes its
         # prefill/decode executables instead of recompiling them
@@ -296,6 +336,10 @@ class InferenceEngine:
             self._prefill_paged_ext_fn, donate_argnums=dargs)
         self._decode_paged_jit = jax.jit(
             self._decode_paged_fn, donate_argnums=dargs)
+        self._prefill_chunk_jit = jax.jit(
+            self._prefill_chunk_fn, donate_argnums=dargs)
+        self._prefill_chunk_paged_jit = jax.jit(
+            self._prefill_chunk_paged_fn, donate_argnums=dargs)
         self._sample_jit = jax.jit(self._sample_from_logits)
 
         # speculative decoding (inference.spec_decode): a draft model +
@@ -338,6 +382,18 @@ class InferenceEngine:
         self._temps = np.zeros(self.batch_slots, np.float32)
         self._top_ps = np.ones(self.batch_slots, np.float32)
         self._admit_counter = itertools.count()
+        # head-of-line admission memo (ISSUE 20 bugfix): once the queue
+        # head fails paged admission, remember (rid, free-block count,
+        # release epoch) and skip re-running the whole radix-match +
+        # alloc dance every tick until blocks could actually have come
+        # free — the epoch catches frees that don't change num_free
+        # (a retirement whose blocks are all radix-pinned still makes
+        # them EVICTABLE, which a pure free-count gate would miss)
+        self._hol_block: Optional[tuple] = None
+        self._release_epoch = 0
+        # chunk-tick expert-stats folds parked until the next real host
+        # sync (folding per chunk tick would add a sync per tick)
+        self._moe_pending: List = []
         self.results: Dict[int, np.ndarray] = {}
         self.request_stats: Dict[int, dict] = {}
         self._request_stats_cap = 4096     # bounded per-request history
@@ -346,6 +402,12 @@ class InferenceEngine:
         # stats machinery (same shape as SpmdTrainer._timings/stats)
         self._timings = {
             "prefill_ms": 0.0, "decode_ms": 0.0, "sync_ms": 0.0,
+            # decode-tick wall time lost to monolithic admission
+            # prefills while other streams sat waiting — the
+            # interference signal the 'prefill-stall' doctor rule reads
+            # (identically 0 in chunked mode, where admission never
+            # stalls the decode batch)
+            "prefill_stall_ms": 0.0,
             "compile_ms_cold": 0.0, "prefills": 0, "prefill_tokens": 0,
             "decode_steps": 0, "tokens_generated": 0,
             "occupancy_sum": 0.0, "block_occupancy_sum": 0.0,
@@ -677,12 +739,35 @@ class InferenceEngine:
         nxt = self._sample_from_logits(logits, sub, temps, top_ps)
         return nxt, key, cache, _moe.fold_expert_stats(b)
 
+    def _prefill_chunk_fn(self, params, cache, tokens, lengths, advance):
+        # chunked prefill (ISSUE 20): one fixed-shape [B, chunk] window
+        # over ALL batch slots — rows with advance=0 write masked
+        # garbage above their valid length, exactly the spec-verify
+        # convention.  `lengths` is the HOST scheduler mirror, so the
+        # executable rewrites every row's in-graph length from it
+        # (retired slots can't leave stale lengths behind).
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(
+                self.model, "prefill_chunk", params, tokens, cache,
+                lengths, advance)
+        return logits, cache, _moe.fold_expert_stats(b)
+
+    def _prefill_chunk_paged_fn(self, params, cache, tokens, tables,
+                                lengths, advance):
+        with _moe.collect_expert_stats() as b:
+            logits, cache = functional_apply(
+                self.model, "prefill_chunk_paged", params, tokens,
+                cache, tables, lengths, advance)
+        return logits, cache, _moe.fold_expert_stats(b)
+
     # ---- timing helpers -----------------------------------------------
     # executable-observatory kind per _timed key family (ISSUE 15): the
     # registry groups rooflines by these
     _EXEC_KIND = {"prefill": "prefill", "prefill_paged": "prefill",
                   "prefill_paged_ext": "prefill", "disagg": "prefill",
                   "disagg_ext": "prefill", "draft_prefill": "prefill",
+                  "prefill_chunk": "prefill",
+                  "prefill_chunk_paged": "prefill",
                   "decode": "decode", "spec_tick": "spec_verify",
                   "sample": "sample", "handoff_gather": "handoff",
                   "handoff_scatter": "handoff"}
@@ -898,6 +983,11 @@ class InferenceEngine:
         if self._spec is not None:
             self._spec.on_release(slot)
         req.slot = None
+        req.prefilling = False
+        req.prefill_pos = 0
+        # any release can make blocks free OR evictable — wake the
+        # head-of-line admission memo (see _hol_block)
+        self._release_epoch += 1
 
     def _preempt(self, req: Request):
         """Kick an active request back onto the queue head: free its
@@ -909,20 +999,32 @@ class InferenceEngine:
             [req.prompt, np.asarray(req.generated, np.int32)])
         req.preemptions += 1
         now = time.perf_counter()
-        req.active_s += now - req.t_live
+        # a still-PREFILLING victim (chunked mode) never went live:
+        # it has no decode activation to account or close
+        if req.t_live is not None:
+            req.active_s += now - req.t_live
         req.t_queue_since = now
         self._timings["preemptions"] += 1
         self._m_preempts.inc()
         if self._tracer.active:
             tr = self._tracer
-            t_live = tr.to_us(req.t_live)
-            tr.complete("decode", t_live, tr.to_us(now) - t_live,
-                        pid=_spans.PID_REQUESTS, tid=req.rid,
-                        cat="request",
-                        args={"tokens": len(req.generated),
-                              "preempted": True})
+            if req.t_live is not None:
+                t_live = tr.to_us(req.t_live)
+                tr.complete("decode", t_live, tr.to_us(now) - t_live,
+                            pid=_spans.PID_REQUESTS, tid=req.rid,
+                            cat="request",
+                            args={"tokens": len(req.generated),
+                                  "preempted": True})
+            else:
+                t_adm = tr.to_us(req.t_admit)
+                tr.complete("prefill", t_adm, tr.to_us(now) - t_adm,
+                            pid=_spans.PID_REQUESTS, tid=req.rid,
+                            cat="request",
+                            args={"chunk_pos": req.prefill_pos,
+                                  "preempted": True})
             tr.instant("preempt", pid=_spans.PID_REQUESTS, tid=req.rid,
                        cat="request", ts_us=tr.to_us(now))
+        req.t_live = None
         self._release_slot(req)
         self._queue.appendleft(req)
 
@@ -978,6 +1080,7 @@ class InferenceEngine:
             req.t_first = now
             self._m_ttft.observe((now - req.t_enqueue) * 1e3)
         req.t_live = now
+        req.token_times.append(now)
         req.queued_s += req.t_admit - req.t_queue_since
         self._timings["prefills"] += 1
         self._m_prefills.inc()
@@ -1162,6 +1265,241 @@ class InferenceEngine:
         self._tables[slot, :len(blocks)] = blocks
         self._record_admission(req, slot, plen, logits)
 
+    # ---- chunked prefill (ISSUE 20) -----------------------------------
+    def _try_admit_chunked(self, req: Request, slot: int) -> bool:
+        """Chunked admission: bind the request to a slot and let
+        _chunk_tick feed its prompt through the chunk executable a
+        budget at a time — NO prefill executable runs here, so the
+        decode batch never stalls behind it.  Paged, the slot starts
+        with blocks covering its radix-matched prefix plus the first
+        chunk; False (pool dry) leaves it at the queue head."""
+        prompt = req.effective_prompt()
+        plen0 = 0
+        if self.kv_layout == "paged":
+            bs = self.block_size
+            pc_stats0 = None
+            if self._prefix is not None:
+                pc_stats0 = (self._prefix.queries,
+                             self._prefix.hit_queries,
+                             self._prefix.hit_blocks)
+                shared, prefix_len = self._prefix.match(prompt)
+            else:
+                shared, prefix_len = [], 0
+            # the match can't exceed the slot's table (coarse pools):
+            # shed cached blocks until it fits, same as _paged_prefill
+            fit = min(self.blocks_per_slot, self._alloc.capacity)
+            shed = 0
+            while shared and len(shared) > fit:
+                shared = shared[:-1]
+                prefix_len -= bs
+                shed += 1
+            if shed and pc_stats0 is not None:
+                self._prefix.hit_blocks -= shed
+                if not shared:
+                    self._prefix.hit_queries -= 1
+            first = min(self.prefill_chunk, prompt.size - prefix_len)
+            need = blocks_for(prefix_len + first, bs)
+            # slot's own reference on the shared prefix BEFORE any
+            # allocation (the aliasing hazard _paged_prefill documents)
+            self._alloc.incref(shared)
+            new_blocks = self._alloc_blocks(need - len(shared))
+            if new_blocks is None:
+                self._alloc.decref(shared)
+                if pc_stats0 is not None:
+                    (self._prefix.queries, self._prefix.hit_queries,
+                     self._prefix.hit_blocks) = pc_stats0
+                return False                  # stay queued; retry later
+            blocks = list(shared) + new_blocks
+            self._slot_blocks[slot] = blocks
+            self._tables[slot, :] = 0
+            self._tables[slot, :len(blocks)] = blocks
+            plen0 = prefix_len
+        now = time.perf_counter()
+        req.t_admit = now
+        req.queued_s += now - req.t_queue_since
+        req.prefilling = True
+        req.prefill_pos = plen0
+        req.slot = slot
+        req.admit_seq = next(self._admit_counter)
+        self._slots[slot] = req
+        self._slot_len[slot] = plen0
+        self._temps[slot] = req.temperature
+        self._top_ps[slot] = req.top_p
+        if self._tracer.active:
+            tr = self._tracer
+            t_q = tr.to_us(req.t_queue_since)
+            tr.complete("queued", t_q, tr.to_us(now) - t_q,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request",
+                        args={"prompt_tokens": int(req.prompt.size),
+                              "resume": req.resume_prompt is not None})
+        return True
+
+    def _ensure_chunk_room(self, req: Request, adv: int) -> int:
+        """Grow ``req``'s block extent to cover its next ``adv`` chunk
+        tokens (free list → radix eviction → preempt-youngest) —
+        _ensure_decode_room made chunk-granular.  Returns the advance
+        that is actually safe: 0 when the requester itself had to be
+        preempted (a still-prefilling requester is ALWAYS resumable —
+        its prompt fits a bucket by add_request and generated is
+        empty — so the degrade path preempts, never retires)."""
+        slot = req.slot
+        while (self._slots[slot] is req and blocks_to_extend(
+                len(self._slot_blocks[slot]),
+                req.prefill_pos + adv, self.block_size) > 0):
+            nb = self._alloc_blocks(1)
+            if nb is None:
+                nb = self._preempt_for_blocks(1, exclude=req)
+            if nb is None:
+                self._preempt(req)
+                break
+            idx = len(self._slot_blocks[slot])
+            self._slot_blocks[slot].append(nb[0])
+            self._tables[slot, idx] = nb[0]
+        return adv if self._slots[slot] is req else 0
+
+    def _chunk_tick(self) -> int:
+        """Advance every still-prefilling slot by up to
+        ``prefill_chunk`` prompt tokens TOTAL (oldest admission first
+        — FIFO inside the tick too) through ONE fixed-shape chunk
+        executable, then graduate slots whose prompt completed.
+        Returns the number of first tokens sampled (graduations) —
+        the same thing monolithic admission counts as produced."""
+        pre = [(s, r) for s, r in enumerate(self._slots)
+               if r is not None and r.prefilling]
+        if not pre:
+            return 0
+        pre.sort(key=lambda sr: sr[1].admit_seq)
+        c = self.prefill_chunk
+        budget = c
+        tokens = np.zeros((self.batch_slots, c), np.int32)
+        advance = np.zeros(self.batch_slots, np.int32)
+        tick_wall0 = time.perf_counter()
+        for slot, req in pre:
+            if budget <= 0:
+                break
+            prompt = req.effective_prompt()
+            adv = min(prompt.size - req.prefill_pos, budget)
+            if self.kv_layout == "paged":
+                # may preempt OTHER prefilling slots (their batch rows
+                # become no-ops: the exec reads tables/lengths at call
+                # time, and a freed slot's zeroed table row routes its
+                # writes into the null block)
+                adv = self._ensure_chunk_room(req, adv)
+            if self._slots[slot] is not req or adv <= 0:
+                continue
+            tokens[slot, :adv] = prompt[req.prefill_pos:
+                                        req.prefill_pos + adv]
+            advance[slot] = adv
+            budget -= adv
+        if not advance.any():
+            return 0
+        self._timings["prefill_tokens"] += int(advance.sum())
+        if self.kv_layout == "paged":
+            logits, cache, moe = self._timed_exec(
+                "prefill_ms", ("prefill_chunk_paged", c),
+                self._prefill_chunk_paged_jit,
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._tables),
+                jnp.asarray(self._slot_len.astype(np.int32)),
+                jnp.asarray(advance))
+        else:
+            logits, cache, moe = self._timed_exec(
+                "prefill_ms", ("prefill_chunk", c),
+                self._prefill_chunk_jit,
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._slot_len.astype(np.int32)),
+                jnp.asarray(advance))
+        self.cache = cache
+        if moe is not None:
+            # park the fold: np.asarray'ing it here would cost a host
+            # sync per chunk tick — it drains at the next real sync
+            self._moe_pending.append(moe)
+        grads = []
+        for slot, req in pre:
+            if self._slots[slot] is not req:
+                continue
+            adv = int(advance[slot])
+            if adv <= 0:
+                continue
+            req.prefill_pos += adv
+            self._slot_len[slot] = req.prefill_pos
+            prompt = req.effective_prompt()
+            if self.kv_layout == "paged" and self._prefix is not None:
+                # progressive adoption: completed blocks join the radix
+                # tree NOW, so a same-prefix request admitted while
+                # this one is mid-prefill already shares them (insert
+                # is idempotent — existing nodes win)
+                n_full = req.prefill_pos // self.block_size
+                if n_full:
+                    self._prefix.insert(
+                        prompt[:n_full * self.block_size],
+                        self._slot_blocks[slot][:n_full])
+            if req.prefill_pos >= prompt.size:
+                grads.append((slot, req))
+        produced = 0
+        if grads:
+            # batch-wide sampling at a FIXED (sample, batch_slots) key:
+            # slicing per graduating slot would compile per slot index
+            self._key, sub = jax.random.split(self._key)
+            tok = self._timed_exec(
+                "prefill_ms", ("sample", self.batch_slots),
+                self._sample_jit, logits, sub,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps))
+            t0 = time.perf_counter()
+            tok_np = np.asarray(tok)
+            self._flush_moe()
+            async_dispatch.record_host_sync()
+            self._timings["sync_ms"] += \
+                (time.perf_counter() - t0) * 1e3
+            for slot, req in grads:
+                self._graduate(req, slot, int(tok_np[slot]))
+                produced += 1
+        _flightrec.record(
+            "chunk_tick",
+            dur_ms=(time.perf_counter() - tick_wall0) * 1e3,
+            prefilling=len(pre), tokens=int(advance.sum()),
+            graduated=produced)
+        return produced
+
+    def _graduate(self, req: Request, slot: int, tok: int):
+        """A slot's prompt completed its last chunk: commit the first
+        sampled token and flip the slot into the decode active set.
+        Mirrors _record_admission's tail — when chunked, the request's
+        first token and lifecycle spans come from here."""
+        now = time.perf_counter()
+        if req.t_first is None:
+            req.t_first = now
+            self._m_ttft.observe((now - req.t_enqueue) * 1e3)
+        req.t_live = now
+        req.prefilling = False
+        req.token_times.append(now)
+        self._timings["prefills"] += 1
+        self._m_prefills.inc()
+        if self._tracer.active:
+            tr = self._tracer
+            t_adm = tr.to_us(req.t_admit)
+            tr.complete("prefill", t_adm, tr.to_us(now) - t_adm,
+                        pid=_spans.PID_REQUESTS, tid=req.rid,
+                        cat="request",
+                        args={"slot": slot, "chunked": True})
+        req.generated.append(tok)
+        self._next_token[slot] = tok
+        self._retire_if_done(req, tok)
+        if self._spec is not None and self._slots[slot] is req:
+            # the draft catches up over the full prompt now — its
+            # (small-model) bucketed prefill runs once per request,
+            # exactly as in monolithic admission
+            self._spec.on_admit(req, slot, tok)
+
+    def _flush_moe(self):
+        """Fold the chunk-tick expert stats parked since the last real
+        host sync (see _chunk_tick) — called wherever the scheduler
+        already blocks on device results, so it adds zero syncs."""
+        for moe in self._moe_pending:
+            self._accum_moe(moe)
+        self._moe_pending.clear()
+
     def _ensure_decode_room(self, need_tokens: int = 1):
         """Before a decode step every active slot whose next
         ``need_tokens`` writes would fall past its block extent gets
@@ -1173,7 +1511,10 @@ class InferenceEngine:
         K+1-token window before knowing how much of it commits."""
         for slot in range(self.batch_slots):
             req = self._slots[slot]
-            if req is None:
+            # still-prefilling slots don't decode — their room is
+            # chunk-granular (_ensure_chunk_room); the decode exec's
+            # write on their row lands in masked garbage / null block
+            if req is None or req.prefilling:
                 continue
             need_blocks = blocks_for(
                 int(self._slot_len[slot]) + need_tokens, self.block_size)
@@ -1230,8 +1571,11 @@ class InferenceEngine:
     def _retire(self, req: Request):
         req.done = True
         req.t_finish = time.perf_counter()
-        req.active_s += req.t_finish - req.t_live
-        if self._tracer.active:
+        # a deadline/drain retirement can hit a still-prefilling slot
+        # (chunked mode) that never went live — nothing to account
+        if req.t_live is not None:
+            req.active_s += req.t_finish - req.t_live
+        if self._tracer.active and req.t_live is not None:
             # close the request track: the decode span of this (final)
             # activation — together with queued/prefill/earlier decode
             # spans this is the full lifecycle timeline
@@ -1249,6 +1593,12 @@ class InferenceEngine:
 
     def _request_record(self, req: Request) -> dict:
         n = len(req.generated)
+        # inter-token latency: gaps between delivery timestamps (first
+        # token included) — the per-request tail the load harness pools
+        # and coordinated-omission-corrects, same contract as TTFT
+        gaps = (np.diff(np.asarray(req.token_times)) * 1e3
+                if len(req.token_times) > 1
+                else np.zeros(0, np.float64))
         return {
             "prompt_tokens": int(req.prompt.size),
             "tokens": n,
@@ -1259,6 +1609,14 @@ class InferenceEngine:
             # over ACTIVE decode time only — requeue waits excluded
             "decode_tokens_per_sec": round((n - 1) / req.active_s, 2)
             if n > 1 and req.active_s > 0 else None,
+            "itl_ms_p50": round(float(np.percentile(gaps, 50)), 3)
+            if gaps.size else None,
+            "itl_ms_p99": round(float(np.percentile(gaps, 99)), 3)
+            if gaps.size else None,
+            # raw gaps (bounded) so the harness can correct the first
+            # gap for scheduled-arrival lateness and pool across
+            # requests
+            "itl_gaps_ms": [round(float(g), 3) for g in gaps[:512]],
             "preemptions": req.preemptions,
             "timed_out": req.timed_out,
         }
@@ -1343,19 +1701,51 @@ class InferenceEngine:
             self._profile.on_step(self._timings["decode_steps"])
         self._m_queue.set(len(self._queue))
         self._retire_expired()
+        stall_t0 = time.perf_counter()
+        had_active = any(r is not None and not r.prefilling
+                         for r in self._slots)
+        admitted = 0
         for slot in range(self.batch_slots):
             if not self._admitting:
                 break
             if self._slots[slot] is not None or not self._queue:
                 continue
+            head = self._queue[0]
+            # head-of-line memo (ISSUE 20 bugfix): the blocked head's
+            # failed radix-match/alloc is NOT re-run until blocks came
+            # free (num_free grew) or became evictable (release epoch
+            # moved) — deadline expiry above still applies to it
+            if (self._alloc is not None and self._hol_block is not None
+                    and self._hol_block[0] == head.rid
+                    and self._alloc.num_free <= self._hol_block[1]
+                    and self._release_epoch == self._hol_block[2]):
+                break
             # paged admission is by FREE BLOCKS, not just a free slot;
             # head-of-line FIFO: if the head can't fit, nobody jumps it
-            if not self._try_admit(self._queue[0], slot):
+            ok = (self._try_admit_chunked(head, slot) if self._chunked
+                  else self._try_admit(head, slot))
+            if not ok:
+                if self._alloc is not None:
+                    self._hol_block = (head.rid, self._alloc.num_free,
+                                       self._release_epoch)
                 break
+            self._hol_block = None
             self._queue.popleft()
-            produced += 1
+            admitted += 1
+            if not self._chunked:
+                produced += 1
+        if not self._chunked and admitted and had_active:
+            # monolithic admission ran its prefill(s) while live decode
+            # streams sat frozen — the interference chunking removes
+            self._timings["prefill_stall_ms"] += \
+                (time.perf_counter() - stall_t0) * 1e3
+        if self._chunked:
+            # NOT gated on _admitting: a draining engine must finish
+            # the prompts already bound to slots
+            produced += self._chunk_tick()
         active_np = np.asarray(
-            [1 if r is not None else 0 for r in self._slots], np.int32)
+            [1 if (r is not None and not r.prefilling) else 0
+             for r in self._slots], np.int32)
         if not active_np.any():
             self._watchdog_idle_if_empty()
             return produced
@@ -1369,8 +1759,8 @@ class InferenceEngine:
             # slots; refresh the mask BEFORE accumulating occupancy so
             # the stats describe the decode step that actually runs
             active_np = np.asarray(
-                [1 if r is not None else 0 for r in self._slots],
-                np.int32)
+                [1 if (r is not None and not r.prefilling) else 0
+                 for r in self._slots], np.int32)
             if not active_np.any():
                 self._watchdog_idle_if_empty()
                 return produced
@@ -1403,6 +1793,7 @@ class InferenceEngine:
         # — fetching it here rides the same sync)
         t0 = time.perf_counter()
         nxt_np = np.asarray(nxt)
+        self._flush_moe()        # parked chunk-tick folds ride this sync
         self._accum_moe(moe)
         async_dispatch.record_host_sync()
         self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
@@ -1414,12 +1805,16 @@ class InferenceEngine:
             self._tracer.complete("decode_tick", tick_t0,
                                   now_us - tick_t0, cat="serve",
                                   args={"active": n_active})
+        commit_now = time.perf_counter()
         for slot, req in enumerate(self._slots):
-            if req is None:
+            # prefilling rows were inactive this step: their sampled
+            # token and cache write are masked garbage, not a commit
+            if req is None or req.prefilling:
                 continue
             tok = int(nxt_np[slot])
             self._slot_len[slot] += 1        # the token we just appended
             req.generated.append(tok)
+            req.token_times.append(commit_now)
             self._next_token[slot] = tok
             produced += 1
             self._timings["tokens_generated"] += 1
@@ -1449,14 +1844,19 @@ class InferenceEngine:
         # prompt + max_new + K <= max_seq (counted below so a
         # mis-sized deployment shows up in stats, not in silence)
         for req in list(self._slots):
-            if req is not None and int(self._slot_len[req.slot]) + k + 1 \
+            if req is not None and not req.prefilling \
+                    and int(self._slot_len[req.slot]) + k + 1 \
                     > self.max_seq_len:
                 self._timings["spec_capacity_retirements"] += 1
                 self._retire(req)
         if self.kv_layout == "paged":
             self._ensure_decode_room(need_tokens=k + 1)
+        # still-prefilling slots (chunked mode) sit the tick out: the
+        # verify window's garbage writes on their rows land above their
+        # valid length and the next chunk scatters over them first
         active_np = np.asarray(
-            [1 if r is not None else 0 for r in self._slots], np.int32)
+            [1 if (r is not None and not r.prefilling) else 0
+             for r in self._slots], np.int32)
         if not active_np.any():
             return 0
         if self.kv_layout == "paged":
@@ -1471,14 +1871,16 @@ class InferenceEngine:
         # committed count per slot, one int32 readback
         t0 = time.perf_counter()
         out_np = np.asarray(out)
+        self._flush_moe()        # parked chunk-tick folds ride this sync
         async_dispatch.record_host_sync()
         self._timings["sync_ms"] += (time.perf_counter() - t0) * 1e3
         self._timings["decode_steps"] += 1
         self._timings["spec_ticks"] += 1
         self._timings["spec_slot_ticks"] += int(active_np.sum())
         produced = 0
+        commit_now = time.perf_counter()
         for slot, req in enumerate(list(self._slots)):
-            if req is None:
+            if req is None or req.prefilling:
                 continue
             n_emit = int(out_np[slot, k + 1])
             toks = out_np[slot, :k + 1]
@@ -1492,6 +1894,7 @@ class InferenceEngine:
             for i in range(n_emit):
                 tok = int(toks[i])
                 req.generated.append(tok)
+                req.token_times.append(commit_now)
                 emitted.append(tok)
                 produced += 1
                 self._timings["tokens_generated"] += 1
@@ -1608,7 +2011,36 @@ class InferenceEngine:
     def flush_prefix_cache(self) -> int:
         """Drop every radix-cache node (slot-held blocks survive under
         the slots' own references). Returns blocks released."""
-        return self._prefix.flush() if self._prefix is not None else 0
+        released = self._prefix.flush() if self._prefix is not None \
+            else 0
+        if released:
+            # freed blocks must wake a memoised blocked head-of-line
+            # request (see _hol_block)
+            self._release_epoch += 1
+        return released
+
+    def set_prefill_chunk(self, chunk: int) -> bool:
+        """Hot-apply the chunked-prefill budget (autotune axis
+        ``prefill_chunk``, ISSUE 20).  The scheduler reads
+        ``self._chunked`` / ``self.prefill_chunk`` fresh every tick,
+        so this is a host-side flag flip — no restart.  A chunk width
+        never run before costs one executable compile, paid here when
+        the replica is quiesced (live-retune episodes always are) and
+        lazily at the next chunk tick otherwise.  Slots currently
+        mid-prefill pin the switch: returns False without changing
+        anything — retry after they graduate."""
+        chunk = int(chunk)
+        if chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got {chunk}")
+        if chunk == self.prefill_chunk:
+            return True
+        if any(r is not None and r.prefilling for r in self._slots):
+            return False
+        self.prefill_chunk = chunk
+        self._chunked = chunk > 0
+        if self._chunked and self.num_active == 0 and not self._queue:
+            self._warmup_chunked()
+        return True
 
     def check_leak_free(self):
         """Drained-engine invariant: with no active slots, no queue and
@@ -1628,7 +2060,12 @@ class InferenceEngine:
         also compile the traced-prefix prefill executable per bucket."""
         assert self.num_active == 0 and not self._queue, \
             "warmup() must run before traffic"
-        if self.kv_layout == "paged":
+        if self._chunked:
+            # chunked mode never runs the bucketed prefill executables
+            # — admission binds slots and the chunk executable does all
+            # prompt work, so that is what warmup compiles
+            self._warmup_chunked()
+        elif self.kv_layout == "paged":
             self._warmup_paged(buckets)
         else:
             self._warmup_dense(buckets)
@@ -1715,6 +2152,51 @@ class InferenceEngine:
             jnp.asarray(self._tables),
             jnp.zeros(self.batch_slots, jnp.int32), self._key,
             jnp.asarray(self._temps), jnp.asarray(self._top_ps))
+        self.cache = cache
+        return self
+
+    def _warmup_chunked(self):
+        """Compile the chunked-mode serving set: the chunk executable,
+        the batch-wide graduation sampler, and the decode executable.
+        All-zero tokens/advance/lengths over the real cache — the
+        garbage writes land above length 0 / in the null block, so
+        nothing needs resetting afterwards."""
+        c = self.prefill_chunk
+        toks = jnp.zeros((self.batch_slots, c), jnp.int32)
+        adv = jnp.zeros((self.batch_slots,), jnp.int32)
+        lens = jnp.zeros((self.batch_slots,), jnp.int32)
+        if self.kv_layout == "paged":
+            logits, cache, _ = self._timed_exec(
+                "prefill_ms", ("prefill_chunk_paged", c),
+                self._prefill_chunk_paged_jit,
+                self.params, self.cache, toks,
+                jnp.asarray(self._tables), lens, adv)
+        else:
+            logits, cache, _ = self._timed_exec(
+                "prefill_ms", ("prefill_chunk", c),
+                self._prefill_chunk_jit,
+                self.params, self.cache, toks, lens, adv)
+        self.cache = cache
+        self._key, sub = jax.random.split(self._key)
+        self._timed_exec(
+            "prefill_ms", ("sample", self.batch_slots),
+            self._sample_jit, logits, sub,
+            jnp.asarray(self._temps), jnp.asarray(self._top_ps))
+        if self.kv_layout == "paged":
+            nxt, self._key, cache, _ = self._timed_exec(
+                "decode_ms", ("decode", 0), self._decode_paged_jit,
+                self.params, self.cache,
+                jnp.zeros(self.batch_slots, jnp.int32),
+                jnp.asarray(self._tables),
+                jnp.zeros(self.batch_slots, jnp.int32), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps))
+        else:
+            nxt, self._key, cache, _ = self._timed_exec(
+                "decode_ms", ("decode", 0), self._decode_jit,
+                self.params, self.cache,
+                jnp.zeros(self.batch_slots, jnp.int32),
+                jnp.zeros(self.batch_slots, jnp.int32), self._key,
+                jnp.asarray(self._temps), jnp.asarray(self._top_ps))
         self.cache = cache
         return self
 
@@ -1832,6 +2314,11 @@ class InferenceEngine:
         s["donate"] = self._donate
         s["kv_layout"] = self.kv_layout
         s["kv_dtype"] = self.kv_dtype or "dense"
+        # chunked prefill (ISSUE 20): mode + chunk size ride every
+        # snapshot (bench rows, loadgen reports, the doctor's
+        # 'prefill-stall' rule gates itself off when chunking is on)
+        s["chunked_prefill"] = self._chunked
+        s["prefill_chunk"] = self.prefill_chunk
         # pod-scale serving (ISSUE 18): tp degree + mesh layout ride
         # every stats snapshot (and through it, bench rows + loadgen
         # reports); the megakernel flag reports what actually runs —
@@ -1919,6 +2406,16 @@ class InferenceEngine:
             p50, p99 = np.percentile(ttfts, [50, 99])
             s["ttft_ms_p50"] = round(float(p50), 3)
             s["ttft_ms_p99"] = round(float(p99), 3)
+        # inter-token latency pooled across finished requests — the
+        # number chunked prefill exists to fix at the tail (the load
+        # harness recomputes these with coordinated-omission lateness
+        # folded into each request's first gap)
+        gaps = [g for r in self.request_stats.values()
+                for g in r.get("itl_gaps_ms") or ()]
+        if gaps:
+            p50, p99 = np.percentile(gaps, [50, 99])
+            s["itl_ms_p50"] = round(float(p50), 3)
+            s["itl_ms_p99"] = round(float(p99), 3)
         # executable observatory (ISSUE 15): the per-kind roofline
         # digest for THIS engine's executables — populated once
         # something ran the deferred analyses (bench legs, the report

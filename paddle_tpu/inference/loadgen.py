@@ -124,6 +124,12 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
             rec = engine.request_stats.pop(r)
             if rec["ttft_ms"] is not None:
                 rec["ttft_ms"] = round(rec["ttft_ms"] + late_ms[r], 3)
+            # the same correction for ITL: lateness delays the FIRST
+            # inter-token interval the user observes — fold it there so
+            # an overloaded harness can't flatter the tail
+            gaps = rec.get("itl_gaps_ms")
+            if gaps:
+                gaps[0] = round(gaps[0] + late_ms[r], 3)
             recs[r] = rec
             engine.results.pop(r, None)
             pending.discard(r)
@@ -159,6 +165,11 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
     ttfts = [r["ttft_ms"] for r in recs if r["ttft_ms"] is not None]
     dtps = [r["decode_tokens_per_sec"] for r in recs
             if r["decode_tokens_per_sec"]]
+    # inter-token latency pooled across requests (per-token samples,
+    # the CO-corrected first gaps included) — the tail chunked prefill
+    # exists to fix: a monolithic admission freezes every in-flight
+    # stream for the length of the longest prompt's prefill
+    itl = [g for r in recs for g in r.get("itl_gaps_ms") or ()]
     total_tokens = sum(r["tokens"] for r in recs)
     report = {
         "num_requests": len(recs),
@@ -172,6 +183,10 @@ def run_loadtest(engine, num_requests: int, rate_rps: float,
         if ttfts else None,
         "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3)
         if ttfts else None,
+        "itl_ms_p50": round(float(np.percentile(itl, 50)), 3)
+        if itl else None,
+        "itl_ms_p99": round(float(np.percentile(itl, 99)), 3)
+        if itl else None,
         "decode_tokens_per_sec_p50": round(float(np.percentile(dtps, 50)),
                                            2) if dtps else None,
         "slot_occupancy": round(
@@ -381,6 +396,11 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
                 rec["ttft_ms"] = round(rec["ttft_ms"] + pending[key], 3)
                 m_ttft.labels(replica=str(ridx)).observe(rec["ttft_ms"])
                 mon.observe(rec["ttft_ms"])
+            gaps = rec.get("itl_gaps_ms")
+            if gaps:
+                # arrival lateness delays the first observed
+                # inter-token interval, same correction as TTFT
+                gaps[0] = round(gaps[0] + pending[key], 3)
             m_tokens.labels(replica=str(ridx)).inc(rec.get("tokens", 0))
             rec["replica"] = ridx
             recs[key] = rec
@@ -448,6 +468,7 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
 
     recs_l = [recs[k] for k in order if k in recs]
     ttfts = [r["ttft_ms"] for r in recs_l if r["ttft_ms"] is not None]
+    itl = [g for r in recs_l for g in r.get("itl_gaps_ms") or ()]
     total_tokens = sum(r["tokens"] for r in recs_l)
     # per-replica occupancy + aggregate prefix hit rate over THIS window
     occ = []
@@ -494,6 +515,10 @@ def run_fleet_loadtest(router, num_requests: int, rate_rps: float,
         if ttfts else None,
         "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 3)
         if ttfts else None,
+        "itl_ms_p50": round(float(np.percentile(itl, 50)), 3)
+        if itl else None,
+        "itl_ms_p99": round(float(np.percentile(itl, 99)), 3)
+        if itl else None,
         "replica_occupancy": occ,
         "requests_per_replica": [n - n0 for n, n0 in
                                  zip(router.routed, rt_snap[2])],

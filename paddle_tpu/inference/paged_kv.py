@@ -48,12 +48,22 @@ import jax.numpy as jnp
 from ..observability import metrics as _metrics
 
 __all__ = ["PagedKVCache", "BlockAllocator", "init_paged_cache",
-           "blocks_for"]
+           "blocks_for", "blocks_to_extend"]
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:
     """Blocks needed to hold ``n_tokens`` positions."""
     return -(-int(n_tokens) // int(block_size))
+
+
+def blocks_to_extend(have_blocks: int, new_len: int,
+                     block_size: int) -> int:
+    """Additional blocks a slot holding ``have_blocks`` needs to cover
+    ``new_len`` positions — the chunk-granular ensure-room arithmetic:
+    a chunked prefill (and a multi-token spec commit) grows a slot by
+    several tokens at once, so room is a delta in BLOCKS, not a
+    yes/no on one."""
+    return max(blocks_for(new_len, block_size) - int(have_blocks), 0)
 
 
 class PagedKVCache:
@@ -148,6 +158,10 @@ class BlockAllocator:
         # LIFO: recently-freed blocks are re-used first (their pool rows
         # are warm in cache on CPU; harmless on TPU)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        # alloc-attempt counter (successes AND refusals): the scheduler
+        # contract that a blocked head-of-line request is NOT re-probed
+        # every tick is asserted against this number
+        self.probes = 0
         # pool pressure into the metrics registry (one gauge set per
         # alloc/decref — attribute arithmetic on a pre-bound child)
         pool = f"p{next(BlockAllocator._ids)}"
@@ -177,6 +191,7 @@ class BlockAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh blocks at refcount 1, or None when the pool cannot
         satisfy the request (caller queues/evicts/preempts)."""
+        self.probes += 1
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]

@@ -1309,6 +1309,65 @@ class GPTModel(Layer):
             cache_v = cache_v.at[i].set(v_pool)
         return self.ln_f(x), type(cache)(cache_k, cache_v, k_sc, v_sc)
 
+    def forward_prefill_chunk(self, tokens, cache: StaticKVCache,
+                              lengths, advance):
+        """Chunked-prefill step for every slot over the DENSE cache —
+        the Sarathi-style stall-free admission primitive: ``tokens
+        [B, C]`` carries the next (up to) C prompt tokens per
+        still-prefilling slot, written and attended with the same
+        window machinery as forward_verify (query i sees positions
+        ``j <= lengths[b]+i``).  ``lengths`` rides in as a HOST
+        operand — the scheduler's per-slot mirror, not
+        ``cache.lengths`` — so a slot retired between chunks can't
+        leave a stale in-graph length behind; ``advance [B]`` (0 for
+        decode/empty slots, the real chunk token count otherwise)
+        advances lengths in-graph so subsequent decode ticks see the
+        grown prefix.  Rows with ``advance[b] < C`` write padded
+        positions above their new length — masked garbage, overwritten
+        by the next chunk or decode, the forward_decode convention.
+        Returns ``(hidden [B, C, H], cache)``."""
+        cfg = self.cfg
+        toks = tokens.data if isinstance(tokens, Tensor) \
+            else jnp.asarray(tokens)
+        b, w = toks.shape
+        lens = jnp.asarray(lengths, jnp.int32)
+        pos = jnp.minimum(
+            lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :],
+            cfg.max_seq_len - 1)
+        x = self.wte(Tensor(toks)) + self.wpe(Tensor(pos))
+        x = self.drop(x)
+        cache_k, cache_v = cache.k, cache.v
+        k_sc, v_sc = cache.k_scale, cache.v_scale
+        for i, blk in enumerate(self.blocks):
+            if k_sc is not None:
+                x, k_layer, v_layer, ks_l, vs_l = blk.forward_verify(
+                    x, cache_k[i], cache_v[i], lens, k_sc[i], v_sc[i])
+                k_sc = k_sc.at[i].set(ks_l)
+                v_sc = v_sc.at[i].set(vs_l)
+            else:
+                x, k_layer, v_layer = blk.forward_verify(
+                    x, cache_k[i], cache_v[i], lens)
+            cache_k = cache_k.at[i].set(k_layer)
+            cache_v = cache_v.at[i].set(v_layer)
+        new_len = jnp.minimum(lens + jnp.asarray(advance, jnp.int32),
+                              cache.capacity)
+        return self.ln_f(x), StaticKVCache(cache_k, cache_v, new_len,
+                                           k_sc, v_sc)
+
+    def forward_prefill_chunk_paged(self, tokens, cache, tables,
+                                    lengths, advance):
+        """Paged twin of forward_prefill_chunk.  The paged layout
+        already keeps lengths on the host (the scheduler owns block
+        accounting), so the chunk step IS the paged verify window —
+        scatter C tokens per slot through the block tables at
+        ``lengths[b]+i`` and attend the staircase; out-of-extent rows
+        (decode slots, padding above ``advance[b]``) write into the
+        reserved null block.  ``advance`` only documents the contract
+        here; the scheduler advances its host lengths itself.  Returns
+        ``(hidden [B, C, H], cache)``."""
+        del advance  # host-side bookkeeping with the paged layout
+        return self.forward_verify_paged(tokens, cache, tables, lengths)
+
     # ---- serving path: paged KV cache ---------------------------------
     def forward_prefill_paged(self, input_ids, cache, table_row,
                               prefix_len):
@@ -1559,6 +1618,38 @@ class GPTForCausalLM(Layer):
                                                  lengths)
         logits = self._head_logits(h)
         return logits.data, cache
+
+    def _chunk_last_logits(self, h, advance):
+        """Gather each slot's LAST-real-chunk-token hidden state
+        (position ``advance[b]-1`` in the window; clamped to 0 for
+        non-participating rows, whose logits the scheduler ignores)
+        and project to logits [B, V] — one head matmul per tick
+        instead of [B, C, V]."""
+        harr = h.data                                     # [B, C, H]
+        idx = jnp.clip(jnp.asarray(advance, jnp.int32) - 1, 0,
+                       harr.shape[1] - 1)
+        last = jnp.take_along_axis(harr, idx[:, None, None],
+                                   axis=1)[:, 0]          # [B, H]
+        logits = self._head_logits(Tensor(last))
+        return logits.data
+
+    def prefill_chunk(self, tokens, cache: StaticKVCache, lengths,
+                      advance):
+        """Chunked-prefill step for all slots (dense cache); returns
+        ``(logits [B, V], cache)`` — logits after each slot's last
+        real chunk token, i.e. the first-generated-token distribution
+        for slots whose chunk completes their prompt."""
+        h, cache = self.gpt.forward_prefill_chunk(tokens, cache,
+                                                  lengths, advance)
+        return self._chunk_last_logits(h, advance), cache
+
+    def prefill_chunk_paged(self, tokens, cache, tables, lengths,
+                            advance):
+        """Paged chunked-prefill step for all slots; returns
+        ``(logits [B, V], cache)``."""
+        h, cache = self.gpt.forward_prefill_chunk_paged(
+            tokens, cache, tables, lengths, advance)
+        return self._chunk_last_logits(h, advance), cache
 
     def prefill_paged(self, input_ids, cache, table_row, prefix_len,
                       suffix_len):

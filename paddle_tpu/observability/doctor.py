@@ -47,6 +47,9 @@ SPEC_ACCEPTANCE_MIN = 0.3
 PREFIX_HIT_RATE_MIN = 0.15
 PREFIX_QUERIES_MIN = 20
 SLOT_OCCUPANCY_MIN = 0.5
+# chunked prefill (ISSUE 20): share of the decode window spent running
+# monolithic prefills while decode-phase slots sat idle
+PREFILL_STALL_FRACTION_MIN = 0.15
 # expert-parallel MoE serving (ISSUE 19): capacity-overflow drop rate
 # and max/mean expert-load skew past these read as imbalance; the rule
 # stays silent until real routed traffic backs the window
@@ -221,6 +224,31 @@ def _prefix_cold(s: dict):
         return None
     return {"prefix_hit_rate": round(hit, 4),
             "prefix_queries": int(q)}, 0.5 * (1.0 - hit)
+
+
+def _prefill_stall(s: dict):
+    """Monolithic prefill stalls running decodes: the engine's
+    ``prefill_stall_ms`` counter accumulates the wall time prefill
+    executables ran while decode-phase requests sat idle in their
+    slots (ISSUE 20).  Evidence is the stall's share of the decode
+    window; chunked mode zeroes the counter by construction, so the
+    rule is structurally silent once its own advice is taken."""
+    if s.get("chunked_prefill"):
+        return None                     # the fix is already on
+    stall = _num(s, "prefill_stall_ms")
+    dec = _num(s, "decode_ms")
+    if not stall or dec is None or (stall + dec) < MIN_WINDOW_MS:
+        return None
+    frac = stall / (stall + dec)
+    if frac < PREFILL_STALL_FRACTION_MIN:
+        return None
+    ev = {"prefill_stall_ms": round(stall, 2),
+          "decode_ms": round(dec, 2),
+          "stall_fraction": round(frac, 4)}
+    p99 = _num(s, "itl_ms_p99")
+    if p99 is not None:
+        ev["itl_ms_p99"] = round(p99, 3)
+    return ev, frac
 
 
 def _idle_slots(s: dict):
@@ -559,6 +587,14 @@ RULES: List[Rule] = [
          action={"op": None, "param": "prefix_cache",
                  "env": "PADDLE_TPU_PREFIX_CACHE",
                  "candidates": [True]}),
+    Rule("prefill-stall", ("serve",),
+         "enable chunked prefill (PADDLE_TPU_CHUNKED_PREFILL=<chunk> / "
+         "engine prefill_chunk=) so prompts are fed through the decode "
+         "tick in fixed-budget chunks instead of stalling the batch",
+         _prefill_stall,
+         action={"op": None, "param": "prefill_chunk",
+                 "env": "PADDLE_TPU_CHUNKED_PREFILL",
+                 "candidates": [32, 64, 128]}),
     Rule("admission-bound", ("serve",),
          "raise batch_slots (PADDLE_TPU_DECODE_SLOTS) / check arrival "
          "rate vs capacity",

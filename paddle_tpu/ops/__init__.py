@@ -10,8 +10,9 @@ from .flash_attention import (  # noqa: F401
     flash_attention, flash_attention_available, get_block_sizes,
     set_interpret_mode)
 from .decode_attention import (  # noqa: F401
-    decode_attention, decode_attention_available,
-    decode_attention_window, paged_decode_attention,
+    chunk_prefill_attention, decode_attention,
+    decode_attention_available, decode_attention_window,
+    paged_chunk_prefill_attention, paged_decode_attention,
     paged_decode_attention_available, paged_decode_attention_window)
 from .fused_cross_entropy import (  # noqa: F401
     fused_linear_cross_entropy, pick_vocab_block)

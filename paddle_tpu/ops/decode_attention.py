@@ -30,6 +30,18 @@ leaving HBM per decode step halve (decode attention is bandwidth-bound
 — that is the whole win); the composites dequantize up front and reuse
 the dense math, which makes them the parity oracle against the fp
 cache at quantization tolerance.
+
+The window entry points (``decode_attention_window`` /
+``paged_decode_attention_window``) are general over the window width W
+and serve TWO schedulers: speculative-decode verify (W = draft K + 1)
+and CHUNKED PREFILL (W = the chunk size) — the Sarathi-style admission
+mode where each tick advances every still-prefilling slot by up to
+`chunk` prompt tokens alongside the decode batch.  Both uses scatter
+the window's k/v first and rely on the same staircase mask (query i
+sees cache position j iff ``j <= lengths[b]+i``), so chunked prefill
+needs no new kernels; the ``chunk_prefill_attention`` aliases at the
+bottom of this module name that second contract explicitly and the
+chunk tests pin it against the composites.
 """
 from __future__ import annotations
 
@@ -50,6 +62,7 @@ _fa = importlib.import_module(__package__ + ".flash_attention")
 __all__ = ["decode_attention", "decode_attention_available",
            "paged_decode_attention", "paged_decode_attention_available",
            "decode_attention_window", "paged_decode_attention_window",
+           "chunk_prefill_attention", "paged_chunk_prefill_attention",
            "set_interpret_mode"]
 
 _NEG = -1e30
@@ -1031,3 +1044,16 @@ def _paged_window_kernel_path(q, k_pool, v_pool, tables, lengths,
                            k_scale, v_scale)
     return o3.reshape(b, hkv, w, h // hkv, d).transpose(0, 2, 1, 3, 4) \
         .reshape(b, w, h, d)
+
+
+# ---- chunked-prefill aliases -------------------------------------------
+# Chunked prefill (ISSUE 20) IS the window attention with W = chunk:
+# the engine scatters a [B, chunk] slice of each still-prefilling
+# slot's prompt at positions lengths..lengths+chunk-1, and query i must
+# see exactly j <= lengths[b]+i — the same staircase the spec verify
+# needs.  The aliases give the chunk scheduler (and its tests) a name
+# for that contract without duplicating a kernel; the support gate,
+# tp shard_map path, int8 scale strips and composite oracles all come
+# along for free.
+chunk_prefill_attention = decode_attention_window
+paged_chunk_prefill_attention = paged_decode_attention_window

@@ -391,16 +391,19 @@ def test_bench_resume_serve_rows(tmp_path, monkeypatch):
            "gen_tokens": 64, "value": 900.0}
     bench._persist_row(row, kind="serve")
     measured = bench._measured_rows("serve")
-    # tp (ISSUE 18) and ep (ISSUE 19) joined the candidate key: a row
-    # without the columns resumes as the tp=1/ep=1 candidate, a tp=2
-    # or ep=2 row is a DIFFERENT point
-    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 1)
+    # tp (ISSUE 18), ep (ISSUE 19) and prefill_chunk (ISSUE 20) joined
+    # the candidate key: a row without the columns resumes as the
+    # tp=1/ep=1/monolithic candidate; a tp=2, ep=2 or chunked row is a
+    # DIFFERENT point
+    key = ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 1, 0)
     assert key in measured and measured[key]["value"] == 900.0
-    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64, 1, 1) \
+    assert ("serve", "gpt3-125m", 8, "dense", False, 128, 64, 1, 1, 0) \
         not in measured
-    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 2, 1) \
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 2, 1, 0) \
         not in measured
-    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 2) \
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 2, 0) \
+        not in measured
+    assert ("serve", "gpt3-125m", 8, "dense", True, 128, 64, 1, 1, 64) \
         not in measured
 
 
